@@ -1,0 +1,37 @@
+"""adanet_tpu: a TPU-native adaptive ensemble / NAS framework.
+
+A from-scratch JAX/XLA re-design with the capabilities of the reference
+TensorFlow AdaNet framework (https://github.com/tensorflow/adanet): iteratively
+generate candidate subnetworks, train them in parallel, combine them with
+complexity-regularized mixture weights, select the best ensemble, and grow.
+
+Top-level API mirrors the reference `adanet/__init__.py`.
+"""
+
+from adanet_tpu import ensemble
+from adanet_tpu import subnetwork
+from adanet_tpu.core.heads import BinaryClassificationHead
+from adanet_tpu.core.heads import Head
+from adanet_tpu.core.heads import MultiClassHead
+from adanet_tpu.core.heads import MultiHead
+from adanet_tpu.core.heads import RegressionHead
+from adanet_tpu.subnetwork import Builder
+from adanet_tpu.subnetwork import Generator
+from adanet_tpu.subnetwork import SimpleGenerator
+from adanet_tpu.subnetwork import Subnetwork
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BinaryClassificationHead",
+    "Builder",
+    "Generator",
+    "Head",
+    "MultiClassHead",
+    "MultiHead",
+    "RegressionHead",
+    "SimpleGenerator",
+    "Subnetwork",
+    "ensemble",
+    "subnetwork",
+]

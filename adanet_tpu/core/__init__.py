@@ -1,0 +1,31 @@
+"""Core engine: iteration building, training, evaluation, checkpointing.
+
+TPU-native analogue of the reference `adanet.core` package
+(reference: adanet/core/__init__.py:18-30).
+"""
+
+from adanet_tpu.core.architecture import Architecture
+from adanet_tpu.core.frozen import FrozenEnsemble
+from adanet_tpu.core.frozen import FrozenSubnetwork
+from adanet_tpu.core.frozen import FrozenWeightedSubnetwork
+from adanet_tpu.core.heads import BinaryClassificationHead
+from adanet_tpu.core.heads import Head
+from adanet_tpu.core.heads import MultiClassHead
+from adanet_tpu.core.heads import MultiHead
+from adanet_tpu.core.heads import RegressionHead
+from adanet_tpu.core.iteration import Iteration
+from adanet_tpu.core.iteration import IterationBuilder
+
+__all__ = [
+    "Architecture",
+    "BinaryClassificationHead",
+    "FrozenEnsemble",
+    "FrozenSubnetwork",
+    "FrozenWeightedSubnetwork",
+    "Head",
+    "Iteration",
+    "IterationBuilder",
+    "MultiClassHead",
+    "MultiHead",
+    "RegressionHead",
+]

@@ -1,0 +1,67 @@
+"""Candidate tracking: EMA of each ensemble candidate's AdaNet loss.
+
+Analogue of the reference `_Candidate`/`_CandidateBuilder`
+(reference: adanet/core/candidate.py:28-138): during training each ensemble
+candidate's `adanet_loss` is tracked as a zero-debiased exponential moving
+average (the reference uses `moving_averages.assign_moving_average`, which is
+zero-debiased) and the best candidate is the argmin of the EMAs. Candidates
+whose loss goes non-finite are quarantined ("dead") and excluded from
+selection — the engine analogue of `_NanLossHook`
+(reference: adanet/core/iteration.py:121-147).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class CandidateState:
+    """Per-candidate moving-average state, updated inside the train step."""
+
+    ema_biased: jnp.ndarray  # decay-weighted sum (before zero-debias)
+    ema_count: jnp.ndarray  # number of EMA updates applied
+    adanet_loss: jnp.ndarray  # last raw adanet loss
+    dead: jnp.ndarray  # True once the loss went non-finite
+
+
+def initial_candidate_state() -> CandidateState:
+    return CandidateState(
+        ema_biased=jnp.asarray(0.0, jnp.float32),
+        ema_count=jnp.asarray(0, jnp.int32),
+        adanet_loss=jnp.asarray(jnp.inf, jnp.float32),
+        dead=jnp.asarray(False),
+    )
+
+
+def update_candidate_state(
+    state: CandidateState, adanet_loss, decay: float
+) -> CandidateState:
+    """One EMA update, with non-finite quarantine. Called inside jit."""
+    adanet_loss = jnp.asarray(adanet_loss, jnp.float32)
+    newly_dead = ~jnp.isfinite(adanet_loss)
+    dead = state.dead | newly_dead
+    update = ~dead
+    biased = jnp.where(
+        update,
+        decay * state.ema_biased + (1.0 - decay) * adanet_loss,
+        state.ema_biased,
+    )
+    count = state.ema_count + update.astype(jnp.int32)
+    return CandidateState(
+        ema_biased=biased,
+        ema_count=count,
+        adanet_loss=jnp.where(update, adanet_loss, state.adanet_loss),
+        dead=dead,
+    )
+
+
+def debiased_ema(state: CandidateState, decay: float):
+    """Zero-debiased EMA value; +inf when never updated or dead."""
+    return jnp.where(
+        (state.ema_count > 0) & ~state.dead,
+        state.ema_biased
+        / (1.0 - jnp.power(decay, state.ema_count.astype(jnp.float32))),
+        jnp.inf,
+    )

@@ -1,0 +1,101 @@
+"""Frozen (host-side) records of trained subnetworks and winning ensembles.
+
+The reference freezes a winning ensemble by keeping its variables in the
+next iteration's graph and rebuilding past iterations from checkpoints
+(reference: adanet/core/estimator.py:1785-1882). In the functional JAX
+design there is no graph to keep alive: the winner is represented by plain
+host-side records holding each member's Flax module (static) and parameter
+pytree (arrays), plus the learned ensembler parameters. These records are
+what builders and generators receive as `previous_ensemble`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+from adanet_tpu.core.architecture import Architecture
+
+
+@dataclasses.dataclass
+class FrozenSubnetwork:
+    """A trained, frozen subnetwork carried into later iterations.
+
+    Attributes:
+      iteration_number: iteration that trained this subnetwork.
+      name: its builder's name.
+      module: the Flax module (rebuilt deterministically from the builder).
+      params: the trained variable collection pytree for `module.apply`.
+      complexity: the subnetwork's scalar complexity r(h) recorded at build.
+      shared: the `Subnetwork.shared` payload recorded at freeze time, the
+        cross-iteration knowledge-sharing channel
+        (reference: adanet/subnetwork/generator.py:110-125).
+    """
+
+    iteration_number: int
+    name: str
+    module: Any
+    params: Any
+    complexity: Any = 0.0
+    shared: Any = None
+
+    def apply(self, features, training: bool = False, rngs=None):
+        """Runs the frozen subnetwork's forward pass."""
+        kwargs = {} if rngs is None else {"rngs": rngs}
+        return self.module.apply(self.params, features, training=training, **kwargs)
+
+
+@dataclasses.dataclass
+class FrozenWeightedSubnetwork:
+    """A frozen member with its learned mixture weight.
+
+    Mirrors the reference's `WeightedSubnetwork` view of a previous ensemble
+    (reference: adanet/ensemble/weighted.py:43-101), so builders can read
+    `previous_ensemble.weighted_subnetworks[-1].subnetwork.shared` exactly as
+    reference search spaces do (reference: adanet/examples/simple_dnn.py:206-209).
+    """
+
+    subnetwork: FrozenSubnetwork
+    weight: Any = None
+
+
+@dataclasses.dataclass
+class FrozenEnsemble:
+    """The frozen winning ensemble of an iteration.
+
+    This is the `previous_ensemble` handed to `Generator.generate_candidates`
+    and `Builder.build_subnetwork` on the next iteration.
+
+    Attributes:
+      name: ensemble candidate name (e.g. "t0_dnn_grow").
+      iteration_number: the iteration this ensemble won.
+      weighted_subnetworks: frozen members with learned weights, oldest first.
+      ensembler_name: name of the ensembler that combined the members.
+      ensembler_params: the learned ensembler parameter pytree (mixture
+        weights and bias for `ComplexityRegularizedEnsembler`).
+      architecture: the serializable `Architecture` record.
+    """
+
+    name: str
+    iteration_number: int
+    weighted_subnetworks: List[FrozenWeightedSubnetwork]
+    ensembler_name: str
+    ensembler_params: Any
+    architecture: Architecture
+
+    @property
+    def subnetworks(self) -> Sequence[FrozenSubnetwork]:
+        return tuple(ws.subnetwork for ws in self.weighted_subnetworks)
+
+    @property
+    def bias(self):
+        if isinstance(self.ensembler_params, dict):
+            return self.ensembler_params.get("bias")
+        return None
+
+    def member_outputs(self, features, training: bool = False):
+        """Forward passes of every frozen member on `features` (inside jit)."""
+        return [
+            ws.subnetwork.apply(features, training=training)
+            for ws in self.weighted_subnetworks
+        ]

@@ -1,0 +1,35 @@
+"""Ensembling API: combine subnetworks into ensembles.
+
+TPU-native analogue of the reference `adanet.ensemble` package
+(reference: adanet/ensemble/__init__.py).
+"""
+
+from adanet_tpu.ensemble.ensembler import Ensemble
+from adanet_tpu.ensemble.ensembler import Ensembler
+from adanet_tpu.ensemble.mean import MeanEnsemble
+from adanet_tpu.ensemble.mean import MeanEnsembler
+from adanet_tpu.ensemble.strategy import AllStrategy
+from adanet_tpu.ensemble.strategy import Candidate
+from adanet_tpu.ensemble.strategy import GrowStrategy
+from adanet_tpu.ensemble.strategy import SoloStrategy
+from adanet_tpu.ensemble.strategy import Strategy
+from adanet_tpu.ensemble.weighted import ComplexityRegularized
+from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
+from adanet_tpu.ensemble.weighted import MixtureWeightType
+from adanet_tpu.ensemble.weighted import WeightedSubnetwork
+
+__all__ = [
+    "AllStrategy",
+    "Candidate",
+    "ComplexityRegularized",
+    "ComplexityRegularizedEnsembler",
+    "Ensemble",
+    "Ensembler",
+    "GrowStrategy",
+    "MeanEnsemble",
+    "MeanEnsembler",
+    "MixtureWeightType",
+    "SoloStrategy",
+    "Strategy",
+    "WeightedSubnetwork",
+]

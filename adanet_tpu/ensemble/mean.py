@@ -1,0 +1,86 @@
+"""Uniform-average ensembler.
+
+Analogue of the reference mean ensembler
+(reference: adanet/ensemble/mean.py:27-135): ensemble logits are the uniform
+mean of member logits; optionally also exposes the mean last layer. Has no
+trainable parameters and no train op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+from adanet_tpu.ensemble.ensembler import Ensemble, Ensembler
+
+
+@struct.dataclass
+class MeanEnsemble(Ensemble):
+    """Mean-of-logits ensemble output (reference: adanet/ensemble/mean.py:27-57).
+
+    Attributes:
+      logits: mean of member logits (or dict for multi-head).
+      subnetworks: member `Subnetwork` outputs.
+      predictions: optional dict holding the mean last layer under
+        `mean_last_layer` when `add_mean_last_layer_predictions=True`.
+    """
+
+    logits: Any
+    subnetworks: List[Any]
+    predictions: Optional[Any] = None
+
+
+MEAN_LAST_LAYER = "mean_last_layer"
+
+
+def _mean(tensors):
+    return jnp.mean(jnp.stack(tensors, axis=0), axis=0)
+
+
+class MeanEnsembler(Ensembler):
+    """Averages member logits uniformly (reference: adanet/ensemble/mean.py:60-135)."""
+
+    def __init__(
+        self, name: Optional[str] = None, add_mean_last_layer_predictions: bool = False
+    ):
+        self._name = name
+        self._add_mean_last_layer_predictions = add_mean_last_layer_predictions
+
+    @property
+    def name(self) -> str:
+        return self._name or "mean"
+
+    def init_ensemble(self, rng, subnetworks, previous_params=None):
+        del rng, subnetworks, previous_params
+        return {}
+
+    def build_ensemble(self, params, subnetworks, previous_ensemble=None):
+        del params, previous_ensemble
+        first_logits = subnetworks[0].logits
+        if isinstance(first_logits, dict):
+            keys = sorted(first_logits)
+            logits = {
+                key: _mean([s.logits[key] for s in subnetworks]) for key in keys
+            }
+        else:
+            logits = _mean([s.logits for s in subnetworks])
+
+        predictions = None
+        if self._add_mean_last_layer_predictions:
+            first_last = subnetworks[0].last_layer
+            if isinstance(first_last, dict):
+                predictions = {
+                    MEAN_LAST_LAYER: {
+                        key: _mean([s.last_layer[key] for s in subnetworks])
+                        for key in sorted(first_last)
+                    }
+                }
+            else:
+                predictions = {
+                    MEAN_LAST_LAYER: _mean([s.last_layer for s in subnetworks])
+                }
+        return MeanEnsemble(
+            logits=logits, subnetworks=list(subnetworks), predictions=predictions
+        )

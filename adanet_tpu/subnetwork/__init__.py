@@ -1,0 +1,21 @@
+"""Search-space API: define candidate subnetworks and how to generate them.
+
+TPU-native analogue of the reference `adanet.subnetwork` package
+(reference: adanet/subnetwork/__init__.py).
+"""
+
+from adanet_tpu.subnetwork.generator import Builder
+from adanet_tpu.subnetwork.generator import Generator
+from adanet_tpu.subnetwork.generator import SimpleGenerator
+from adanet_tpu.subnetwork.generator import Subnetwork
+from adanet_tpu.subnetwork.report import MaterializedReport
+from adanet_tpu.subnetwork.report import Report
+
+__all__ = [
+    "Builder",
+    "Generator",
+    "SimpleGenerator",
+    "Subnetwork",
+    "MaterializedReport",
+    "Report",
+]

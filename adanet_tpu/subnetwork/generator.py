@@ -1,0 +1,164 @@
+"""Search-space API: subnetworks, builders, and generators.
+
+TPU-native (JAX/Flax) re-design of the reference search-space API
+(reference: adanet/subnetwork/generator.py:39-339). The reference builds TF
+graph pieces inside a shared graph; here a `Builder` returns a Flax module
+plus an optax optimizer, and the engine owns initialization, jit-compiled
+train steps, and state. There is no `TrainOpSpec` analogue: the "train op" is
+the optax `GradientTransformation` returned by `build_train_optimizer`
+(reference: adanet/subnetwork/generator.py:39-59).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional, Sequence
+
+from flax import struct
+
+
+@struct.dataclass
+class Subnetwork:
+    """An ensemble building block: the `h` in the AdaNet paper.
+
+    JAX pytree analogue of the reference `adanet.subnetwork.Subnetwork` named
+    tuple (reference: adanet/subnetwork/generator.py:62-158). Returned by the
+    Flax module that `Builder.build_subnetwork` constructs.
+
+    Attributes:
+      last_layer: `jnp.ndarray` output of the subnetwork's last hidden layer
+        (or dict of head-name to array for multi-head). Used by ensemblers
+        with MATRIX mixture weights, and by subsequent subnetworks that want
+        to build on top of it via knowledge transfer.
+      logits: `jnp.ndarray` logits (or dict for multi-head). Must match the
+        head's logits dimension.
+      complexity: scalar measure r(h) of the subnetwork's complexity (e.g.
+        sqrt of depth in the simple_dnn example); enters the complexity
+        regularization term `(lambda * r(h) + beta) * |w|_1`.
+      shared: arbitrary auxiliary pytree shared with future iterations (the
+        reference passes python/tensor state across iterations the same way,
+        e.g. `num_layers` in examples/simple_dnn.py:206-209).
+    """
+
+    last_layer: Any
+    logits: Any
+    complexity: Any = 0.0
+    shared: Any = None
+
+
+class Builder(abc.ABC):
+    """Interface for building one candidate subnetwork.
+
+    Analogue of the reference `adanet.subnetwork.Builder` ABC (reference:
+    adanet/subnetwork/generator.py:161-270), re-cast functionally:
+
+    - `build_subnetwork` returns a Flax `nn.Module` whose
+      `__call__(features, training: bool) -> Subnetwork`. The engine calls
+      `module.init` once and drives jit-compiled train steps.
+    - `build_train_optimizer` returns the optax transform used to train this
+      subnetwork's parameters on the head loss of its own logits (analogue of
+      `build_subnetwork_train_op`, generator.py:226-253).
+
+    Builders must be deterministic: the engine re-invokes them to rebuild
+    frozen iterations from checkpoints, exactly as the reference re-runs
+    builders when reconstructing past iterations
+    (reference: adanet/core/estimator.py:1785-1882).
+    """
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str:
+        """Unique name of this subnetwork within an iteration."""
+
+    @abc.abstractmethod
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        """Returns a Flax module producing a `Subnetwork`.
+
+        Args:
+          logits_dimension: int (or dict of head-name to int for multi-head)
+            dimension of the logits the head expects.
+          previous_ensemble: the frozen `FrozenEnsemble` from the previous
+            iteration, or None on iteration 0. Builders may read
+            `previous_ensemble.weighted_subnetworks[-1].subnetwork.shared`
+            to adapt (reference: examples/simple_dnn.py:206-209); they may
+            also reuse frozen modules/params for knowledge transfer.
+
+        Returns:
+          A `flax.linen.Module`; `module.apply(variables, features,
+          training=..., rngs=...)` must return a `Subnetwork`.
+        """
+
+    @abc.abstractmethod
+    def build_train_optimizer(self, previous_ensemble=None):
+        """Returns the optax `GradientTransformation` for this subnetwork."""
+
+    def build_subnetwork_report(self):
+        """Optionally returns a `Report` of hparams/attributes/metrics.
+
+        Analogue of reference generator.py:255-270; default None means no
+        report for this subnetwork.
+        """
+        return None
+
+
+class Generator(abc.ABC):
+    """Interface for generating the candidate pool each iteration.
+
+    Analogue of the reference `adanet.subnetwork.Generator`
+    (reference: adanet/subnetwork/generator.py:273-325). Implementations must
+    be deterministic given the same arguments, since the engine replays
+    generation to rebuild past iterations from checkpoints.
+    """
+
+    @abc.abstractmethod
+    def generate_candidates(
+        self,
+        previous_ensemble,
+        iteration_number: int,
+        previous_ensemble_reports: Sequence[Any],
+        all_reports: Sequence[Any],
+        config: Optional[Any] = None,
+    ) -> List[Builder]:
+        """Generates `Builder`s to train this iteration.
+
+        Args:
+          previous_ensemble: frozen winning `FrozenEnsemble` of iteration
+            t-1, or None at t=0.
+          iteration_number: zero-based iteration (boosting round) t.
+          previous_ensemble_reports: `MaterializedReport`s of members of the
+            previous best ensemble.
+          all_reports: all `MaterializedReport`s from all previous
+            iterations.
+          config: optional run configuration.
+
+        Returns:
+          A list of `Builder` instances with unique names.
+        """
+
+
+class SimpleGenerator(Generator):
+    """Generates the same fixed pool of builders every iteration.
+
+    Analogue of reference `adanet.subnetwork.SimpleGenerator`
+    (reference: adanet/subnetwork/generator.py:328-339).
+    """
+
+    def __init__(self, subnetwork_builders: Sequence[Builder]):
+        if not subnetwork_builders:
+            raise ValueError("subnetwork_builders must be non-empty.")
+        names = [b.name for b in subnetwork_builders]
+        if len(set(names)) != len(names):
+            raise ValueError("Builder names must be unique, got %s" % names)
+        self._builders = list(subnetwork_builders)
+
+    def generate_candidates(
+        self,
+        previous_ensemble,
+        iteration_number,
+        previous_ensemble_reports,
+        all_reports,
+        config=None,
+    ) -> List[Builder]:
+        del previous_ensemble, iteration_number  # fixed pool
+        del previous_ensemble_reports, all_reports, config
+        return list(self._builders)

@@ -1,0 +1,7 @@
+"""Shared utilities."""
+
+from adanet_tpu.utils.trees import tree_finite
+from adanet_tpu.utils.trees import tree_where
+from adanet_tpu.utils.trees import tree_zeros_like
+
+__all__ = ["tree_finite", "tree_where", "tree_zeros_like"]

@@ -1,0 +1,35 @@
+"""Small pytree utilities used across the engine."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_where(pred, on_true, on_false):
+    """Elementwise `jnp.where(pred, a, b)` over matching pytrees.
+
+    `pred` is a scalar boolean (traced or concrete); used e.g. to freeze a
+    candidate's parameters once its loss goes non-finite (the quarantine
+    analogue of the reference's `_NanLossHook`,
+    reference: adanet/core/iteration.py:121-147).
+    """
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false
+    )
+
+
+def tree_finite(tree) -> jnp.ndarray:
+    """Scalar bool: True iff every leaf of the pytree is entirely finite."""
+    leaves = [
+        jnp.all(jnp.isfinite(leaf))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+def tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Must set the XLA flags before jax initializes; tests exercise all sharding
+paths on virtual CPU devices (the analogue of the reference's TF_CONFIG
+localhost clusters, reference: adanet/core/estimator_distributed_test.py).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,131 @@
+"""Hermetic test fixtures: dummy builders, generators, and input pipelines.
+
+The analogue of the reference's `adanet/core/testing_utils.py` fixture layer
+(reference: adanet/core/testing_utils.py:60-292).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from adanet_tpu.subnetwork import Builder, Report, Subnetwork
+
+
+class _DNNModule(nn.Module):
+    """A tiny DNN producing a `Subnetwork`."""
+
+    logits_dimension: int
+    num_layers: int
+    hidden: int = 8
+    seed_offset: int = 0
+    nan_logits: bool = False
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = features["x"] if isinstance(features, dict) else features
+        x = jnp.asarray(x, jnp.float32)
+        for i in range(self.num_layers):
+            x = nn.Dense(self.hidden, name="dense_%d" % i)(x)
+            x = nn.relu(x)
+        logits = nn.Dense(self.logits_dimension, name="logits")(x)
+        if self.nan_logits:
+            logits = logits * jnp.nan
+        return Subnetwork(
+            last_layer=x,
+            logits=logits,
+            complexity=float(np.sqrt(max(self.num_layers, 1))),
+            shared={"num_layers": self.num_layers},
+        )
+
+
+class DNNBuilder(Builder):
+    """Test analogue of reference `_DNNBuilder`
+    (reference: adanet/core/estimator_test.py:66-182)."""
+
+    def __init__(
+        self,
+        name: str,
+        num_layers: int = 1,
+        learning_rate: float = 0.1,
+        hidden: int = 8,
+        nan_logits: bool = False,
+        with_report: bool = False,
+    ):
+        self._name = name
+        self._num_layers = num_layers
+        self._learning_rate = learning_rate
+        self._hidden = hidden
+        self._nan_logits = nan_logits
+        self._with_report = with_report
+
+    @property
+    def name(self):
+        return self._name
+
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        return _DNNModule(
+            logits_dimension=logits_dimension,
+            num_layers=self._num_layers,
+            hidden=self._hidden,
+            nan_logits=self._nan_logits,
+        )
+
+    def build_train_optimizer(self, previous_ensemble=None):
+        return optax.sgd(self._learning_rate)
+
+    def build_subnetwork_report(self):
+        if not self._with_report:
+            return None
+        return Report(
+            hparams={"num_layers": self._num_layers},
+            attributes={"name": self._name},
+            metrics={
+                "mean_logit": lambda subnetwork, features, labels: jnp.mean(
+                    subnetwork.logits
+                )
+            },
+        )
+
+
+def linear_dataset(
+    n: int = 64,
+    dim: int = 2,
+    batch_size: int = 16,
+    seed: int = 42,
+    classification: bool = False,
+):
+    """Deterministic toy dataset; returns an input_fn-style callable."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype(np.float32)
+    w = np.linspace(1.0, 2.0, dim).astype(np.float32)
+    y = x @ w[:, None] + 0.1 * rng.randn(n, 1).astype(np.float32)
+    if classification:
+        y = (y > 0).astype(np.float32)
+
+    def input_fn():
+        for start in range(0, n, batch_size):
+            yield (
+                {"x": x[start : start + batch_size]},
+                y[start : start + batch_size],
+            )
+
+    return input_fn
+
+
+def repeating_input_fn(input_fn, max_batches: int):
+    """Wraps a finite input_fn into one that repeats up to max_batches."""
+
+    def repeated():
+        count = 0
+        while count < max_batches:
+            for batch in input_fn():
+                if count >= max_batches:
+                    return
+                yield batch
+                count += 1
+
+    return repeated

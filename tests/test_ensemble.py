@@ -1,0 +1,187 @@
+"""Ensembler API tests (reference coverage: adanet/ensemble, weighted.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adanet_tpu.ensemble import (
+    AllStrategy,
+    ComplexityRegularizedEnsembler,
+    GrowStrategy,
+    MeanEnsembler,
+    MixtureWeightType,
+    SoloStrategy,
+)
+from adanet_tpu.subnetwork import Subnetwork
+
+
+def _subnetwork(logits, last_layer=None, complexity=1.0):
+    return Subnetwork(
+        last_layer=last_layer if last_layer is not None else logits,
+        logits=logits,
+        complexity=complexity,
+    )
+
+
+def _members(n=3, batch=4, dim=2, last_dim=5):
+    rng = np.random.RandomState(0)
+    return [
+        _subnetwork(
+            jnp.asarray(rng.randn(batch, dim), jnp.float32),
+            jnp.asarray(rng.randn(batch, last_dim), jnp.float32),
+            complexity=float(i + 1),
+        )
+        for i in range(n)
+    ]
+
+
+class TestComplexityRegularized:
+    def test_scalar_init_is_uniform_average(self):
+        members = _members(4)
+        ens = ComplexityRegularizedEnsembler()
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        assert len(params["weights"]) == 4
+        for w in params["weights"]:
+            assert w.shape == ()
+            np.testing.assert_allclose(w, 0.25)
+        out = ens.build_ensemble(params, members)
+        expected = sum(np.asarray(m.logits) for m in members) / 4.0
+        np.testing.assert_allclose(out.logits, expected, rtol=1e-5)
+
+    def test_vector_weights(self):
+        members = _members(2)
+        ens = ComplexityRegularizedEnsembler(
+            mixture_weight_type=MixtureWeightType.VECTOR
+        )
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        assert params["weights"][0].shape == (2,)
+        out = ens.build_ensemble(params, members)
+        assert out.logits.shape == (4, 2)
+
+    def test_matrix_weights_zero_init(self):
+        members = _members(2)
+        ens = ComplexityRegularizedEnsembler(
+            mixture_weight_type=MixtureWeightType.MATRIX, use_bias=True
+        )
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        assert params["weights"][0].shape == (5, 2)
+        np.testing.assert_allclose(params["weights"][0], 0.0)
+        out = ens.build_ensemble(params, members)
+        np.testing.assert_allclose(out.logits, 0.0)  # zeros @ W + zero bias
+
+    def test_matrix_weights_rank3_last_layer(self):
+        rng = np.random.RandomState(0)
+        members = [
+            Subnetwork(
+                last_layer=jnp.asarray(rng.randn(4, 3, 5), jnp.float32),
+                logits=jnp.asarray(rng.randn(4, 3, 2), jnp.float32),
+                complexity=1.0,
+            )
+        ]
+        ens = ComplexityRegularizedEnsembler(
+            mixture_weight_type=MixtureWeightType.MATRIX,
+            mixture_weight_initializer=lambda rng, shape, dtype: jnp.ones(
+                shape, dtype
+            ),
+        )
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        out = ens.build_ensemble(params, members)
+        assert out.logits.shape == (4, 3, 2)
+        expected = np.asarray(members[0].last_layer) @ np.ones((5, 2))
+        np.testing.assert_allclose(out.logits, expected, rtol=1e-5)
+
+    def test_complexity_regularization_value(self):
+        # sum_j (lambda * r_j + beta) * |w_j|_1 with scalar w_j = 1/2.
+        members = _members(2)  # complexities 1.0, 2.0
+        ens = ComplexityRegularizedEnsembler(adanet_lambda=0.1, adanet_beta=0.01)
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        out = ens.build_ensemble(params, members)
+        expected = (0.1 * 1.0 + 0.01) * 0.5 + (0.1 * 2.0 + 0.01) * 0.5
+        np.testing.assert_allclose(
+            out.complexity_regularization, expected, rtol=1e-5
+        )
+
+    def test_no_regularization_when_lambda_beta_zero(self):
+        members = _members(2)
+        ens = ComplexityRegularizedEnsembler()
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        out = ens.build_ensemble(params, members)
+        np.testing.assert_allclose(out.complexity_regularization, 0.0)
+
+    def test_warm_start(self):
+        members = _members(3)
+        ens = ComplexityRegularizedEnsembler(warm_start_mixture_weights=True)
+        prev = {
+            "weights": [jnp.asarray(0.7), None, None],
+            "bias": None,
+        }
+        params = ens.init_ensemble(
+            jax.random.PRNGKey(0), members, previous_params=prev
+        )
+        np.testing.assert_allclose(params["weights"][0], 0.7)
+        np.testing.assert_allclose(params["weights"][1], 1.0 / 3)
+
+    def test_multi_head_logits(self):
+        rng = np.random.RandomState(0)
+        members = [
+            Subnetwork(
+                last_layer={
+                    "a": jnp.asarray(rng.randn(4, 5), jnp.float32),
+                    "b": jnp.asarray(rng.randn(4, 5), jnp.float32),
+                },
+                logits={
+                    "a": jnp.asarray(rng.randn(4, 2), jnp.float32),
+                    "b": jnp.asarray(rng.randn(4, 3), jnp.float32),
+                },
+                complexity=1.0,
+            )
+            for _ in range(2)
+        ]
+        ens = ComplexityRegularizedEnsembler(
+            adanet_lambda=0.1, use_bias=True
+        )
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        out = ens.build_ensemble(params, members)
+        assert out.logits["a"].shape == (4, 2)
+        assert out.logits["b"].shape == (4, 3)
+        assert float(out.complexity_regularization) > 0.0
+
+
+class TestMeanEnsembler:
+    def test_mean_logits(self):
+        members = _members(3)
+        ens = MeanEnsembler()
+        out = ens.build_ensemble({}, members)
+        expected = np.mean([np.asarray(m.logits) for m in members], axis=0)
+        np.testing.assert_allclose(out.logits, expected, rtol=1e-5)
+
+    def test_mean_last_layer_predictions(self):
+        members = _members(3)
+        ens = MeanEnsembler(add_mean_last_layer_predictions=True)
+        out = ens.build_ensemble({}, members)
+        assert out.predictions["mean_last_layer"].shape == (4, 5)
+
+
+class TestStrategies:
+    class _FakeBuilder:
+        def __init__(self, name):
+            self.name = name
+
+    def test_solo(self):
+        builders = [self._FakeBuilder("a"), self._FakeBuilder("b")]
+        cands = SoloStrategy().generate_ensemble_candidates(builders, ["p"])
+        assert [c.name for c in cands] == ["a_solo", "b_solo"]
+        assert all(not c.previous_ensemble_subnetworks for c in cands)
+
+    def test_grow(self):
+        builders = [self._FakeBuilder("a")]
+        cands = GrowStrategy().generate_ensemble_candidates(builders, ["p"])
+        assert cands[0].name == "a_grow"
+        assert cands[0].previous_ensemble_subnetworks == ("p",)
+
+    def test_all(self):
+        builders = [self._FakeBuilder("a"), self._FakeBuilder("b")]
+        cands = AllStrategy().generate_ensemble_candidates(builders, ["p"])
+        assert len(cands) == 1
+        assert len(cands[0].subnetwork_builders) == 2

@@ -1,0 +1,69 @@
+"""Head tests (loss/prediction/metric semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adanet_tpu.core.heads import (
+    BinaryClassificationHead,
+    MultiClassHead,
+    MultiHead,
+    RegressionHead,
+)
+
+
+def test_regression_head():
+    head = RegressionHead()
+    logits = jnp.asarray([[1.0], [2.0]])
+    labels = jnp.asarray([[0.0], [2.0]])
+    np.testing.assert_allclose(head.loss(logits, labels), 0.5)
+    assert head.logits_dimension == 1
+    preds = head.predictions(logits)
+    np.testing.assert_allclose(preds["predictions"], logits)
+
+
+def test_binary_head():
+    head = BinaryClassificationHead()
+    logits = jnp.asarray([[10.0], [-10.0]])
+    labels = jnp.asarray([[1.0], [0.0]])
+    assert float(head.loss(logits, labels)) < 1e-3
+    metrics = head.eval_metrics(logits, labels)
+    np.testing.assert_allclose(metrics["accuracy"], 1.0)
+    preds = head.predictions(logits)
+    assert preds["class_ids"].tolist() == [[1], [0]]
+    assert preds["probabilities"].shape == (2, 2)
+
+
+def test_multiclass_head():
+    head = MultiClassHead(n_classes=3)
+    logits = jnp.asarray([[5.0, 0.0, 0.0], [0.0, 5.0, 0.0]])
+    labels = jnp.asarray([0, 1])
+    assert float(head.loss(logits, labels)) < 0.05
+    metrics = head.eval_metrics(logits, labels)
+    np.testing.assert_allclose(metrics["accuracy"], 1.0)
+    assert head.predictions(logits)["class_ids"].tolist() == [0, 1]
+
+
+def test_multiclass_head_requires_two_classes():
+    with pytest.raises(ValueError):
+        MultiClassHead(n_classes=1)
+
+
+def test_multi_head():
+    head = MultiHead(
+        [RegressionHead(name="reg"), MultiClassHead(3, name="cls")],
+        head_weights=[1.0, 2.0],
+    )
+    logits = {
+        "reg": jnp.asarray([[1.0]]),
+        "cls": jnp.asarray([[5.0, 0.0, 0.0]]),
+    }
+    labels = {"reg": jnp.asarray([[1.0]]), "cls": jnp.asarray([0])}
+    assert head.logits_dimension == {"reg": 1, "cls": 3}
+    loss = float(head.loss(logits, labels))
+    cls_loss = float(MultiClassHead(3).loss(logits["cls"], labels["cls"]))
+    np.testing.assert_allclose(loss, 2.0 * cls_loss, rtol=1e-5)
+    metrics = head.eval_metrics(logits, labels)
+    assert "cls/accuracy" in metrics
+    preds = head.predictions(logits)
+    assert "reg/predictions" in preds

@@ -1,0 +1,188 @@
+"""Engine tests: iteration build/train/eval/select/freeze.
+
+Covers the behavior the reference exercises in
+adanet/core/iteration_test.py and candidate_test.py, re-cast for the
+functional engine.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from adanet_tpu.core.heads import RegressionHead
+from adanet_tpu.core.iteration import IterationBuilder
+from adanet_tpu.ensemble import (
+    AllStrategy,
+    ComplexityRegularizedEnsembler,
+    GrowStrategy,
+    MeanEnsembler,
+    SoloStrategy,
+)
+
+from helpers import DNNBuilder, linear_dataset
+
+
+def _builder_factory(decay=0.9, ensemblers=None, strategies=None):
+    return IterationBuilder(
+        head=RegressionHead(),
+        ensemblers=ensemblers
+        or [ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        ensemble_strategies=strategies or [GrowStrategy()],
+        adanet_loss_decay=decay,
+    )
+
+
+def _sample_batch():
+    return next(linear_dataset()())
+
+
+def test_build_iteration_names_and_members():
+    it = _builder_factory(
+        strategies=[GrowStrategy(), SoloStrategy(), AllStrategy()]
+    ).build_iteration(
+        0, [DNNBuilder("dnn", 1), DNNBuilder("deep", 2)], None
+    )
+    names = it.candidate_names()
+    assert names == [
+        "t0_dnn_grow",
+        "t0_deep_grow",
+        "t0_dnn_solo",
+        "t0_deep_solo",
+        "t0_all",
+    ]
+    all_spec = it.ensemble_specs[-1]
+    assert len(all_spec.members) == 2
+
+
+def test_train_step_reduces_loss():
+    it = _builder_factory().build_iteration(0, [DNNBuilder("dnn", 1)], None)
+    state = it.init_state(jax.random.PRNGKey(0), _sample_batch())
+    batches = list(linear_dataset()())
+    first_loss = None
+    metrics = None
+    for _ in range(20):
+        for batch in batches:
+            state, metrics = it.train_step(state, batch)
+            if first_loss is None:
+                first_loss = float(metrics["adanet_loss/t0_dnn_grow"])
+    final_loss = float(metrics["adanet_loss/t0_dnn_grow"])
+    assert final_loss < first_loss
+    assert int(state.iteration_step) == 20 * len(batches)
+    assert int(state.subnetworks["dnn"].step) == 20 * len(batches)
+
+
+def test_best_candidate_selection_and_freeze():
+    it = _builder_factory(strategies=[GrowStrategy()]).build_iteration(
+        0, [DNNBuilder("good", 2), DNNBuilder("nan", 1, nan_logits=True)], None
+    )
+    state = it.init_state(jax.random.PRNGKey(0), _sample_batch())
+    for batch in linear_dataset()():
+        state, _ = it.train_step(state, batch)
+    emas = it.ema_losses(state)
+    assert emas["t0_nan_grow"] == float("inf")  # quarantined
+    assert np.isfinite(emas["t0_good_grow"])
+    best = it.best_candidate_index(state)
+    assert it.candidate_names()[best] == "t0_good_grow"
+
+    frozen = it.freeze_candidate(state, "t0_good_grow", _sample_batch())
+    assert frozen.iteration_number == 0
+    assert len(frozen.weighted_subnetworks) == 1
+    fs = frozen.weighted_subnetworks[0].subnetwork
+    assert fs.name == "good"
+    assert fs.shared == {"num_layers": 2}
+    arch = frozen.architecture
+    assert arch.subnetworks == ((0, "good"),)
+
+
+def test_all_candidates_nan_raises():
+    it = _builder_factory().build_iteration(
+        0, [DNNBuilder("nan", 1, nan_logits=True)], None
+    )
+    state = it.init_state(jax.random.PRNGKey(0), _sample_batch())
+    for batch in linear_dataset()():
+        state, _ = it.train_step(state, batch)
+    with pytest.raises(FloatingPointError):
+        it.best_candidate_index(state)
+
+
+def test_second_iteration_grows_on_frozen_ensemble():
+    builder_factory = _builder_factory()
+    it0 = builder_factory.build_iteration(0, [DNNBuilder("dnn", 1)], None)
+    state0 = it0.init_state(jax.random.PRNGKey(0), _sample_batch())
+    for batch in linear_dataset()():
+        state0, _ = it0.train_step(state0, batch)
+    frozen = it0.freeze_candidate(state0, "t0_dnn_grow", _sample_batch())
+
+    it1 = builder_factory.build_iteration(
+        1, [DNNBuilder("dnn2", 2)], frozen
+    )
+    # The grow candidate includes the frozen member + the new builder.
+    spec = it1.ensemble_specs[0]
+    assert spec.name == "t1_dnn2_grow"
+    assert len(spec.members) == 2
+    assert spec.architecture.subnetworks == ((0, "dnn"), (1, "dnn2"))
+
+    state1 = it1.init_state(jax.random.PRNGKey(1), _sample_batch())
+    for batch in linear_dataset()():
+        state1, metrics = it1.train_step(state1, batch)
+    assert np.isfinite(float(metrics["adanet_loss/t1_dnn2_grow"]))
+
+    frozen1 = it1.freeze_candidate(state1, "t1_dnn2_grow", _sample_batch())
+    assert [ws.subnetwork.name for ws in frozen1.weighted_subnetworks] == [
+        "dnn",
+        "dnn2",
+    ]
+
+
+def test_warm_start_skipped_across_different_ensemblers():
+    """Weights learned by one ensembler must not warm-start another."""
+    from adanet_tpu.ensemble import MixtureWeightType
+
+    scalar = ComplexityRegularizedEnsembler(
+        optimizer=optax.sgd(0.05), warm_start_mixture_weights=True
+    )
+    fac0 = _builder_factory(ensemblers=[scalar])
+    it0 = fac0.build_iteration(0, [DNNBuilder("dnn", 1)], None)
+    state0 = it0.init_state(jax.random.PRNGKey(0), _sample_batch())
+    frozen = it0.freeze_candidate(state0, "t0_dnn_grow", _sample_batch())
+
+    matrix = ComplexityRegularizedEnsembler(
+        optimizer=optax.sgd(0.05),
+        mixture_weight_type=MixtureWeightType.MATRIX,
+        warm_start_mixture_weights=True,
+        name="matrix",
+    )
+    it1 = _builder_factory(ensemblers=[matrix]).build_iteration(
+        1, [DNNBuilder("dnn2", 1)], frozen
+    )
+    state1 = it1.init_state(jax.random.PRNGKey(1), _sample_batch())
+    # Kept member's weight must be a fresh MATRIX init, not the scalar.
+    w0 = state1.ensembles["t1_dnn2_grow"].params["weights"][0]
+    assert w0.ndim == 2
+    state1, metrics = it1.train_step(state1, _sample_batch())
+    assert np.isfinite(float(metrics["adanet_loss/t1_dnn2_grow"]))
+
+
+def test_eval_step_metrics():
+    it = _builder_factory().build_iteration(0, [DNNBuilder("dnn", 1)], None)
+    state = it.init_state(jax.random.PRNGKey(0), _sample_batch())
+    results = it.eval_step(state, _sample_batch())
+    assert "t0_dnn_grow" in results
+    assert "average_loss" in results["t0_dnn_grow"]
+    assert "subnetwork/dnn" in results
+
+
+def test_mean_ensembler_and_multiple_ensemblers():
+    it = _builder_factory(
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05)),
+            MeanEnsembler(),
+        ]
+    ).build_iteration(0, [DNNBuilder("dnn", 1)], None)
+    names = it.candidate_names()
+    assert "t0_dnn_grow_complexity_regularized" in names
+    assert "t0_dnn_grow_mean" in names
+    state = it.init_state(jax.random.PRNGKey(0), _sample_batch())
+    state, metrics = it.train_step(state, _sample_batch())
+    assert np.isfinite(float(metrics["adanet_loss/t0_dnn_grow_mean"]))

@@ -9,12 +9,17 @@ Top-level API mirrors the reference `adanet/__init__.py`.
 """
 
 from adanet_tpu import ensemble
+from adanet_tpu import replay
 from adanet_tpu import subnetwork
+from adanet_tpu.core.estimator import Estimator
+from adanet_tpu.core.evaluator import Evaluator
+from adanet_tpu.core.evaluator import Objective
 from adanet_tpu.core.heads import BinaryClassificationHead
 from adanet_tpu.core.heads import Head
 from adanet_tpu.core.heads import MultiClassHead
 from adanet_tpu.core.heads import MultiHead
 from adanet_tpu.core.heads import RegressionHead
+from adanet_tpu.core.report_materializer import ReportMaterializer
 from adanet_tpu.subnetwork import Builder
 from adanet_tpu.subnetwork import Generator
 from adanet_tpu.subnetwork import SimpleGenerator
@@ -25,13 +30,18 @@ __version__ = "0.1.0"
 __all__ = [
     "BinaryClassificationHead",
     "Builder",
+    "Estimator",
+    "Evaluator",
     "Generator",
     "Head",
     "MultiClassHead",
     "MultiHead",
+    "Objective",
     "RegressionHead",
+    "ReportMaterializer",
     "SimpleGenerator",
     "Subnetwork",
     "ensemble",
+    "replay",
     "subnetwork",
 ]

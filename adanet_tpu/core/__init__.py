@@ -5,6 +5,9 @@ TPU-native analogue of the reference `adanet.core` package
 """
 
 from adanet_tpu.core.architecture import Architecture
+from adanet_tpu.core.estimator import Estimator
+from adanet_tpu.core.evaluator import Evaluator
+from adanet_tpu.core.evaluator import Objective
 from adanet_tpu.core.frozen import FrozenEnsemble
 from adanet_tpu.core.frozen import FrozenSubnetwork
 from adanet_tpu.core.frozen import FrozenWeightedSubnetwork
@@ -15,10 +18,14 @@ from adanet_tpu.core.heads import MultiHead
 from adanet_tpu.core.heads import RegressionHead
 from adanet_tpu.core.iteration import Iteration
 from adanet_tpu.core.iteration import IterationBuilder
+from adanet_tpu.core.report_accessor import ReportAccessor
+from adanet_tpu.core.report_materializer import ReportMaterializer
 
 __all__ = [
     "Architecture",
     "BinaryClassificationHead",
+    "Estimator",
+    "Evaluator",
     "FrozenEnsemble",
     "FrozenSubnetwork",
     "FrozenWeightedSubnetwork",
@@ -27,5 +34,8 @@ __all__ = [
     "IterationBuilder",
     "MultiClassHead",
     "MultiHead",
+    "Objective",
     "RegressionHead",
+    "ReportAccessor",
+    "ReportMaterializer",
 ]

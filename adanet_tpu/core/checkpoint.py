@@ -1,0 +1,200 @@
+"""Durable checkpointing for the AdaNet search loop.
+
+TPU-native replacement for the reference's Saver/`tf.train.Checkpoint`
+machinery (reference: adanet/core/estimator.py:236-331,
+adanet/core/iteration.py:1188-1230). The reference grows a graph and
+overwrites checkpoints between iterations; here state is functional, so a
+checkpoint is just serialized pytrees plus a JSON manifest:
+
+- `frozen-<t>.msgpack`: the winning ensemble of iteration t (params,
+  mixture weights, complexity/shared payloads). One per completed
+  iteration, enabling the deterministic rebuild chain: generators are
+  replayed with the *restored* previous ensemble, exactly as the reference
+  re-runs builders when reconstructing past iterations
+  (reference: adanet/core/estimator.py:1785-1882).
+- `ckpt-<step>.msgpack`: the full mid-iteration `IterationState` for
+  preemption-safe resume (the analogue of `_TrainManager`'s durable state,
+  reference: adanet/core/iteration.py:40-118).
+- `checkpoint.json`: manifest holding iteration_number, global_step, and
+  which files are current. The iteration number lives in the checkpoint in
+  the reference too (estimator.py:877-879) — it is what lets training
+  stop/restart anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import jax
+from flax import serialization
+
+MANIFEST = "checkpoint.json"
+
+
+@dataclasses.dataclass
+class CheckpointInfo:
+    """Parsed manifest contents."""
+
+    iteration_number: int = 0
+    global_step: int = 0
+    iteration_state_file: Optional[str] = None
+    replay_indices: List[int] = dataclasses.field(default_factory=list)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    _atomic_write_bytes(path, json.dumps(obj, sort_keys=True).encode())
+
+
+def read_manifest(model_dir: str) -> Optional[CheckpointInfo]:
+    path = os.path.join(model_dir, MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        obj = json.load(f)
+    return CheckpointInfo(
+        iteration_number=int(obj["iteration_number"]),
+        global_step=int(obj["global_step"]),
+        iteration_state_file=obj.get("iteration_state_file"),
+        replay_indices=list(obj.get("replay_indices", [])),
+    )
+
+
+def write_manifest(model_dir: str, info: CheckpointInfo) -> None:
+    os.makedirs(model_dir, exist_ok=True)
+    _atomic_write_json(
+        os.path.join(model_dir, MANIFEST),
+        {
+            "iteration_number": info.iteration_number,
+            "global_step": info.global_step,
+            "iteration_state_file": info.iteration_state_file,
+            "replay_indices": info.replay_indices,
+        },
+    )
+
+
+def save_pytree(model_dir: str, filename: str, payload: Any) -> str:
+    """Serializes a pytree (flax state-dict encoding) atomically."""
+    os.makedirs(model_dir, exist_ok=True)
+    data = serialization.to_bytes(jax.device_get(payload))
+    _atomic_write_bytes(os.path.join(model_dir, filename), data)
+    return filename
+
+
+def restore_pytree(model_dir: str, filename: str, target: Any) -> Any:
+    """Restores a pytree saved by `save_pytree` onto a matching target."""
+    with open(os.path.join(model_dir, filename), "rb") as f:
+        return serialization.from_bytes(target, f.read())
+
+
+def save_payload(model_dir: str, filename: str, payload: Any) -> str:
+    """Serializes a plain payload (dicts/lists/arrays) without re-keying.
+
+    Unlike `save_pytree`, lists stay lists (`to_bytes` would convert them to
+    string-keyed dicts via the state-dict encoding).
+    """
+    os.makedirs(model_dir, exist_ok=True)
+    data = serialization.msgpack_serialize(jax.device_get(payload))
+    _atomic_write_bytes(os.path.join(model_dir, filename), data)
+    return filename
+
+
+def restore_payload(model_dir: str, filename: str) -> Any:
+    """Restores a payload as plain dicts/lists (no target structure needed).
+
+    Used for frozen-ensemble payloads, which are plain nested dicts of
+    arrays/primitives by construction.
+    """
+    with open(os.path.join(model_dir, filename), "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def frozen_filename(iteration_number: int) -> str:
+    return "frozen-%d.msgpack" % iteration_number
+
+
+def iteration_state_filename(global_step: int) -> str:
+    return "ckpt-%d.msgpack" % global_step
+
+
+def architecture_filename(iteration_number: int) -> str:
+    """Reference layout: `<model_dir>/architecture-<t>.json`
+    (reference: adanet/core/estimator.py:1725-1747)."""
+    return "architecture-%d.json" % iteration_number
+
+
+# ------------------------------------------------------ frozen (de)serialize
+
+
+def frozen_to_payload(frozen) -> Dict[str, Any]:
+    """Host-side serializable payload of a `FrozenEnsemble`.
+
+    Modules and the architecture are NOT stored: they are rebuilt
+    deterministically from the generator + architecture JSON; this payload
+    restores the numeric state onto that rebuilt skeleton.
+    """
+    members = []
+    for ws in frozen.weighted_subnetworks:
+        members.append(
+            {
+                "params": jax.device_get(ws.subnetwork.params),
+                "weight": (
+                    {}
+                    if ws.weight is None
+                    else {"value": jax.device_get(ws.weight)}
+                ),
+                "complexity": float(ws.subnetwork.complexity),
+                "shared": (
+                    {}
+                    if ws.subnetwork.shared is None
+                    else {"value": jax.device_get(ws.subnetwork.shared)}
+                ),
+            }
+        )
+    return {
+        "members": members,
+        "ensembler_params": (
+            {}
+            if frozen.ensembler_params is None
+            else {"value": jax.device_get(frozen.ensembler_params)}
+        ),
+        "final_ema": (
+            float(frozen.final_ema)
+            if frozen.final_ema is not None
+            else float("inf")
+        ),
+    }
+
+
+def payload_into_frozen(payload: Dict[str, Any], frozen) -> None:
+    """Grafts a restored payload's values onto a rebuilt `FrozenEnsemble`.
+
+    `frozen` must have the same member structure (same builders rebuilt in
+    the same order); its placeholder params are replaced in-place.
+    """
+    members = payload["members"]
+    if len(members) != len(frozen.weighted_subnetworks):
+        raise ValueError(
+            "Checkpoint has %d members but rebuilt ensemble has %d. The "
+            "generator is not deterministic or the model_dir is stale."
+            % (len(members), len(frozen.weighted_subnetworks))
+        )
+    for entry, ws in zip(members, frozen.weighted_subnetworks):
+        ws.subnetwork.params = entry["params"]
+        ws.weight = entry["weight"].get("value")
+        ws.subnetwork.complexity = entry["complexity"]
+        shared = entry["shared"]
+        ws.subnetwork.shared = shared.get("value") if shared else None
+    frozen.ensembler_params = payload["ensembler_params"].get("value")
+    ema = payload.get("final_ema", float("inf"))
+    frozen.final_ema = None if ema == float("inf") else float(ema)

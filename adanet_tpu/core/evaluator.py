@@ -1,0 +1,89 @@
+"""Evaluator: score every candidate ensemble over a fixed dataset.
+
+Analogue of the reference `Evaluator`
+(reference: adanet/core/evaluator.py:31-140): between iterations, the engine
+runs every candidate's metrics over the evaluation dataset in a single pass
+(one jitted eval step per batch covers all candidates at once) and selects
+the best index by the configured objective.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class Objective(str, enum.Enum):
+    """Direction of the evaluation metric (reference: evaluator.py:36-50)."""
+
+    MINIMIZE = "minimize"
+    MAXIMIZE = "maximize"
+
+
+class Evaluator:
+    """Evaluates candidate ensembles on a shared dataset.
+
+    Args:
+      input_fn: zero-arg callable returning an iterator of (features, labels)
+        batches (the evaluation set).
+      steps: number of batches to evaluate; None means until exhaustion.
+      metric_name: which metric from the iteration's eval results to compare
+        candidates by (default "adanet_loss").
+      objective: `Objective` or its string value; MINIMIZE for losses,
+        MAXIMIZE for e.g. accuracy.
+    """
+
+    def __init__(
+        self,
+        input_fn: Callable,
+        steps: Optional[int] = None,
+        metric_name: str = "adanet_loss",
+        objective: Objective = Objective.MINIMIZE,
+    ):
+        self._input_fn = input_fn
+        self._steps = steps
+        self._metric_name = metric_name
+        self._objective = Objective(objective)
+
+    @property
+    def input_fn(self):
+        return self._input_fn
+
+    @property
+    def steps(self):
+        return self._steps
+
+    @property
+    def metric_name(self) -> str:
+        return self._metric_name
+
+    @property
+    def objective(self) -> Objective:
+        return self._objective
+
+    @property
+    def objective_fn(self):
+        """np.nanargmin / np.nanargmax (reference: evaluator.py:80-95)."""
+        if self._objective == Objective.MINIMIZE:
+            return np.nanargmin
+        return np.nanargmax
+
+    def evaluate(self, iteration, state) -> List[float]:
+        """Mean metric per candidate, in `iteration.candidate_names()` order."""
+        names = iteration.candidate_names()
+        totals = {name: 0.0 for name in names}
+        count = 0
+        for batch in self._input_fn():
+            if self._steps is not None and count >= self._steps:
+                break
+            results = iteration.eval_step(state, batch)
+            host = jax.device_get({name: results[name] for name in names})
+            for name in names:
+                totals[name] += float(host[name][self._metric_name])
+            count += 1
+        if count == 0:
+            raise ValueError("Evaluator input_fn yielded no batches.")
+        return [totals[name] / count for name in names]

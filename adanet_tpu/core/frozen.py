@@ -82,6 +82,9 @@ class FrozenEnsemble:
     ensembler_name: str
     ensembler_params: Any
     architecture: Architecture
+    # The training-loss EMA this ensemble finished its iteration with; seeds
+    # the carried-over candidate's frozen EMA at the next iteration.
+    final_ema: Optional[float] = None
 
     @property
     def subnetworks(self) -> Sequence[FrozenSubnetwork]:

@@ -22,6 +22,7 @@ Key mappings:
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import flax
@@ -92,7 +93,14 @@ class SubnetworkSpec:
 
 @dataclasses.dataclass(frozen=True)
 class EnsembleSpec:
-    """Static description of one ensemble candidate × ensembler."""
+    """Static description of one ensemble candidate × ensembler.
+
+    `track_ema=False` marks the carried-over previous-ensemble candidate: its
+    loss EMA stays frozen at the value it finished the previous iteration
+    with, matching the reference's rebuilt (read-only) moving average
+    (reference: adanet/core/candidate.py:104-127 with rebuilding=True).
+    `initial_params` carries the previous winner's learned ensembler params.
+    """
 
     name: str
     candidate_name: str
@@ -100,6 +108,9 @@ class EnsembleSpec:
     tx: Optional[Any]
     members: Tuple[Tuple[str, Any], ...]  # (_NEW, name) | (_FROZEN, index)
     architecture: Architecture
+    track_ema: bool = True
+    initial_params: Optional[Any] = None
+    initial_ema: Optional[float] = None
 
 
 def _complexity_regularization(ensemble):
@@ -175,21 +186,43 @@ class Iteration:
         cand_states = {}
         for espec in self.ensemble_specs:
             rng, ens_rng = jax.random.split(rng)
-            member_shapes = [
-                sub_shapes[ref] if kind == _NEW else frozen_shapes[ref]
-                for kind, ref in espec.members
-            ]
-            previous_params = self._warm_start_params(espec)
-            params = espec.ensembler.init_ensemble(
-                ens_rng, member_shapes, previous_params=previous_params
-            )
+            if espec.initial_params is not None:
+                params = jax.tree_util.tree_map(
+                    jnp.asarray, espec.initial_params
+                )
+            else:
+                member_shapes = [
+                    sub_shapes[ref] if kind == _NEW else frozen_shapes[ref]
+                    for kind, ref in espec.members
+                ]
+                previous_params = self._warm_start_params(espec)
+                params = espec.ensembler.init_ensemble(
+                    ens_rng, member_shapes, previous_params=previous_params
+                )
             opt_state = (
                 espec.tx.init(params) if espec.tx is not None else ()
             )
             ens_states[espec.name] = EnsembleTrainState(
                 params=params, opt_state=opt_state
             )
-            cand_states[espec.name] = candidate_lib.initial_candidate_state()
+            cstate = candidate_lib.initial_candidate_state()
+            if espec.initial_ema is not None and math.isfinite(
+                espec.initial_ema
+            ):
+                # Seed the frozen EMA so the carried-over previous ensemble
+                # competes at the loss it finished iteration t-1 with.
+                cstate = candidate_lib.CandidateState(
+                    ema_biased=jnp.asarray(
+                        espec.initial_ema * (1.0 - self.adanet_loss_decay),
+                        jnp.float32,
+                    ),
+                    ema_count=jnp.asarray(1, jnp.int32),
+                    adanet_loss=jnp.asarray(
+                        espec.initial_ema, jnp.float32
+                    ),
+                    dead=jnp.asarray(False),
+                )
+            cand_states[espec.name] = cstate
 
         return IterationState(
             subnetworks=sub_states,
@@ -355,11 +388,16 @@ class Iteration:
                     opt_state=tree_where(ok, new_opt, est.opt_state),
                 )
             new_ensembles[espec.name] = new_est
-            new_candidates[espec.name] = candidate_lib.update_candidate_state(
-                state.candidates[espec.name],
-                adanet_loss,
-                self.adanet_loss_decay,
-            )
+            if espec.track_ema:
+                new_candidates[espec.name] = (
+                    candidate_lib.update_candidate_state(
+                        state.candidates[espec.name],
+                        adanet_loss,
+                        self.adanet_loss_decay,
+                    )
+                )
+            else:
+                new_candidates[espec.name] = state.candidates[espec.name]
             metrics["adanet_loss/%s" % espec.name] = adanet_loss
             metrics["ensemble_loss/%s" % espec.name] = loss
 
@@ -436,24 +474,29 @@ class Iteration:
         self,
         state: IterationState,
         override: Optional[int] = None,
+        exclude_first: bool = False,
     ) -> int:
         """Argmin over candidate EMAs (reference: iteration.py:1011-1046).
 
         Non-finite candidates are quarantined (never selected); if every
         candidate is dead this raises, the analogue of TF's
-        `NanLossDuringTrainingError`.
+        `NanLossDuringTrainingError`. `exclude_first=True` implements
+        `force_grow` at t>0: the zero-th (previous-ensemble) candidate is
+        ignored (reference: estimator.py:1447-1451, 1504-1511).
         """
         if override is not None:
             return int(override)
         emas = self.ema_losses(state)
         losses = [emas[spec.name] for spec in self.ensemble_specs]
-        finite = [l for l in losses if l != float("inf")]
+        start = 1 if exclude_first and len(losses) > 1 else 0
+        candidates = list(range(start, len(losses)))
+        finite = [i for i in candidates if losses[i] != float("inf")]
         if not finite:
             raise FloatingPointError(
                 "All %d ensemble candidates have non-finite AdaNet losses."
-                % len(losses)
+                % len(candidates)
             )
-        return int(min(range(len(losses)), key=lambda i: losses[i]))
+        return int(min(finite, key=lambda i: losses[i]))
 
     def ensemble_forward(
         self, state: IterationState, spec_name: str, features
@@ -541,6 +584,7 @@ class Iteration:
             ensembler_name=espec.ensembler.name,
             ensembler_params=params,
             architecture=espec.architecture,
+            final_ema=self.ema_losses(state).get(espec.name),
         )
 
 
@@ -566,6 +610,16 @@ class IterationBuilder:
         self._ensemblers = list(ensemblers)
         self._strategies = list(ensemble_strategies)
         self._adanet_loss_decay = float(adanet_loss_decay)
+
+    def _ensembler_by_name(self, name: str):
+        for ensembler in self._ensemblers:
+            if ensembler.name == name:
+                return ensembler
+        raise ValueError(
+            "Previous ensemble was built by ensembler %r which is not among "
+            "this run's ensemblers %s."
+            % (name, [e.name for e in self._ensemblers])
+        )
 
     def build_iteration(
         self,
@@ -601,15 +655,42 @@ class IterationBuilder:
 
         ensemble_specs = []
         seen = set()
+        # At t>0 the zero-th candidate is always the carried-over previous
+        # ensemble, competing at its frozen loss EMA with untrained (frozen)
+        # params (reference: adanet/core/iteration.py:592-606,
+        # estimator.py:1447-1451).
+        if previous_ensemble is not None:
+            ensembler = self._ensembler_by_name(
+                previous_ensemble.ensembler_name
+            )
+            members = tuple(
+                (_FROZEN, i) for i in range(len(frozen_members))
+            )
+            ensemble_specs.append(
+                EnsembleSpec(
+                    name=previous_ensemble.name,
+                    candidate_name=previous_ensemble.name,
+                    ensembler=ensembler,
+                    tx=None,
+                    members=members,
+                    architecture=previous_ensemble.architecture,
+                    track_ema=False,
+                    initial_params=previous_ensemble.ensembler_params,
+                    initial_ema=previous_ensemble.final_ema,
+                )
+            )
+            seen.add(previous_ensemble.name)
         for strategy in self._strategies:
             candidates = strategy.generate_ensemble_candidates(
                 subnetwork_builders, frozen_members or None
             )
             for cand in candidates:
                 for ensembler in self._ensemblers:
-                    name = "t{}_{}".format(iteration_number, cand.name)
-                    if len(self._ensemblers) > 1:
-                        name = "{}_{}".format(name, ensembler.name)
+                    # Reference naming: "t{}_{}_{}" with the ensembler name
+                    # always appended (reference: iteration.py:694-697).
+                    name = "t{}_{}_{}".format(
+                        iteration_number, cand.name, ensembler.name
+                    )
                     if name in seen:
                         raise ValueError(
                             "Duplicate ensemble candidate name %r" % name

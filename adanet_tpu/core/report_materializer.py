@@ -1,0 +1,105 @@
+"""Materialize subnetwork reports over a dataset.
+
+Analogue of the reference `ReportMaterializer`
+(reference: adanet/core/report_materializer.py:74-160): turns each trained
+subnetwork's `Report` metric callables into python primitives by averaging
+them over a report dataset, producing `MaterializedReport`s the next
+iteration's `Generator` can adapt to.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+
+from adanet_tpu.subnetwork.report import MaterializedReport, Report
+
+
+class ReportMaterializer:
+    """Materializes `Report`s into `MaterializedReport`s.
+
+    Args:
+      input_fn: zero-arg callable returning an iterator of (features, labels)
+        batches to materialize report metrics over.
+      steps: number of batches; None means until exhaustion.
+    """
+
+    def __init__(self, input_fn: Callable, steps: Optional[int] = None):
+        self._input_fn = input_fn
+        self._steps = steps
+
+    @property
+    def input_fn(self):
+        return self._input_fn
+
+    @property
+    def steps(self):
+        return self._steps
+
+    def materialize_subnetwork_reports(
+        self,
+        iteration,
+        state,
+        included_subnetwork_names: Sequence[str],
+    ) -> List[MaterializedReport]:
+        """Computes every subnetwork's report metrics over the dataset."""
+        reports = {}
+        for spec in iteration.subnetwork_specs:
+            report = spec.builder.build_subnetwork_report() or Report()
+            reports[spec.name] = report
+
+        # One jitted pass computes every report metric for every subnetwork.
+        def batch_metrics(st, features, labels):
+            out = {}
+            for spec in iteration.subnetwork_specs:
+                subnetwork = spec.module.apply(
+                    st.subnetworks[spec.name].variables,
+                    features,
+                    training=False,
+                )
+                metrics = {
+                    name: fn(subnetwork, features, labels)
+                    for name, fn in reports[spec.name].metrics.items()
+                }
+                metrics["loss"] = iteration.head.loss(
+                    subnetwork.logits, labels
+                )
+                out[spec.name] = metrics
+            return out
+
+        jitted = jax.jit(batch_metrics)
+        totals = {name: {} for name in reports}
+        count = 0
+        for features, labels in self._input_fn():
+            if self._steps is not None and count >= self._steps:
+                break
+            host = jax.device_get(jitted(state, features, labels))
+            for name, metrics in host.items():
+                for key, value in metrics.items():
+                    totals[name][key] = totals[name].get(key, 0.0) + float(
+                        value
+                    )
+            count += 1
+        if count == 0:
+            raise ValueError("Report input_fn yielded no batches.")
+
+        materialized = []
+        for spec in iteration.subnetwork_specs:
+            report = reports[spec.name]
+            materialized.append(
+                MaterializedReport(
+                    iteration_number=iteration.iteration_number,
+                    name=spec.name,
+                    hparams=dict(report.hparams),
+                    attributes=dict(report.attributes),
+                    metrics={
+                        key: value / count
+                        for key, value in totals[spec.name].items()
+                    },
+                    included_in_final_ensemble=(
+                        spec.name in set(included_subnetwork_names)
+                    ),
+                )
+            )
+        return materialized

@@ -1,0 +1,31 @@
+"""Deterministic replay of a finished AdaNet search.
+
+Analogue of the reference `adanet.replay`
+(reference: adanet/replay/__init__.py:28-62): a `Config` holding the
+best-ensemble index chosen at each iteration of a previous run, so the
+search can be re-run (e.g. on fresh data) without any evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class Config:
+    """Holds the best ensemble indices of a previous run's iterations."""
+
+    def __init__(self, best_ensemble_indices: Optional[Sequence[int]] = None):
+        self._best_ensemble_indices = list(best_ensemble_indices or [])
+
+    @property
+    def best_ensemble_indices(self):
+        return list(self._best_ensemble_indices)
+
+    def get_best_ensemble_index(self, iteration_number: int) -> Optional[int]:
+        """The recorded winner for `iteration_number`, or None past the end."""
+        if iteration_number < len(self._best_ensemble_indices):
+            return self._best_ensemble_indices[iteration_number]
+        return None
+
+
+__all__ = ["Config"]

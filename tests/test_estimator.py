@@ -1,0 +1,233 @@
+"""Estimator lifecycle tests.
+
+The analogue of the reference's single-process integration suite
+(reference: adanet/core/estimator_test.py): full
+train→evaluate→predict→export lifecycles, checkpoint/resume, replay,
+force_grow, evaluator-based selection, and report round-trips.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import adanet_tpu
+from adanet_tpu import replay
+from adanet_tpu.core.estimator import Estimator
+from adanet_tpu.core.evaluator import Evaluator, Objective
+from adanet_tpu.core.report_materializer import ReportMaterializer
+from adanet_tpu.ensemble import (
+    ComplexityRegularizedEnsembler,
+    GrowStrategy,
+    SoloStrategy,
+)
+from adanet_tpu.subnetwork import SimpleGenerator
+
+from helpers import DNNBuilder, linear_dataset
+
+
+def _make_estimator(tmp_path, **kwargs):
+    defaults = dict(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("dnn", 1), DNNBuilder("deep", 2)]
+        ),
+        max_iteration_steps=8,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        model_dir=str(tmp_path / "model"),
+        log_every_steps=0,
+    )
+    defaults.update(kwargs)
+    return Estimator(**defaults)
+
+
+def test_lifecycle(tmp_path):
+    """train → evaluate → predict → export (reference: test_lifecycle)."""
+    est = _make_estimator(tmp_path, max_iterations=2)
+    est.train(linear_dataset(), max_steps=100)
+    assert est.latest_iteration_number() == 2
+    assert est.latest_global_step() == 16  # 2 iterations x 8 steps
+
+    metrics = est.evaluate(linear_dataset())
+    assert np.isfinite(metrics["average_loss"])
+    assert metrics["global_step"] == 16
+
+    preds = list(est.predict(linear_dataset()))
+    assert len(preds) == 4  # 64 examples / batch 16
+    assert preds[0]["predictions"].shape == (16, 1)
+
+    sample = next(linear_dataset()())
+    export_dir = est.export_saved_model(str(tmp_path / "export"), sample)
+    assert os.path.exists(os.path.join(export_dir, "architecture.json"))
+    assert os.path.exists(os.path.join(export_dir, "ensemble.msgpack"))
+
+    # Architecture files exist per iteration with correct members.
+    arch0 = json.load(open(os.path.join(est.model_dir, "architecture-0.json")))
+    assert len(arch0["subnetworks"]) == 1
+    arch1 = json.load(open(os.path.join(est.model_dir, "architecture-1.json")))
+    assert len(arch1["replay_indices"]) == 2
+
+
+def test_resume_from_checkpoint(tmp_path):
+    """Stop/restart anywhere (reference: estimator_test.py:1659-1744)."""
+    est = _make_estimator(tmp_path, max_iterations=2)
+    # Stop mid-iteration-0 (max_steps=5 < 8 iteration steps).
+    est.train(linear_dataset(), max_steps=5)
+    assert est.latest_iteration_number() == 0
+    assert est.latest_global_step() == 5
+
+    # A fresh Estimator over the same model_dir resumes and finishes.
+    est2 = _make_estimator(tmp_path, max_iterations=2)
+    est2.train(linear_dataset(), max_steps=100)
+    assert est2.latest_iteration_number() == 2
+    assert est2.latest_global_step() == 16
+    metrics = est2.evaluate(linear_dataset())
+    assert np.isfinite(metrics["average_loss"])
+
+
+def test_training_continues_decreasing_loss(tmp_path):
+    est = _make_estimator(tmp_path, max_iterations=3, max_iteration_steps=20)
+    est.train(linear_dataset(), max_steps=200)
+    metrics = est.evaluate(linear_dataset())
+    # Three boosting iterations of SGD on a linear problem: loss must be low.
+    assert metrics["average_loss"] < 0.3
+
+
+def test_force_grow_never_reselects_previous(tmp_path):
+    est = _make_estimator(
+        tmp_path,
+        max_iterations=3,
+        force_grow=True,
+        # Learning rate 0 so new candidates never beat the previous ensemble
+        # on merit; only force_grow makes the ensemble grow.
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("frozen", 1, learning_rate=0.0)]
+        ),
+    )
+    est.train(linear_dataset(), max_steps=1000)
+    arch = json.load(
+        open(os.path.join(est.model_dir, "architecture-2.json"))
+    )
+    # With force_grow the winner at every t>0 must include a new member.
+    assert len(arch["subnetworks"]) == 3
+
+
+def test_evaluator_based_selection(tmp_path):
+    est = _make_estimator(
+        tmp_path,
+        max_iterations=1,
+        evaluator=Evaluator(input_fn=linear_dataset(), steps=2),
+    )
+    est.train(linear_dataset(), max_steps=8)
+    assert est.latest_iteration_number() == 1
+    metrics = est.evaluate(linear_dataset())
+    assert np.isfinite(metrics["average_loss"])
+
+
+def test_replay(tmp_path):
+    """Replay reruns recorded choices without evaluation
+    (reference: EstimatorReplayTest, estimator_test.py:3235)."""
+    est = _make_estimator(tmp_path, max_iterations=2)
+    est.train(linear_dataset(), max_steps=100)
+    manifest = json.load(
+        open(os.path.join(est.model_dir, "checkpoint.json"))
+    )
+    indices = manifest["replay_indices"]
+    assert len(indices) == 2
+
+    est2 = _make_estimator(
+        tmp_path,
+        model_dir=str(tmp_path / "replayed"),
+        max_iterations=2,
+        replay_config=replay.Config(best_ensemble_indices=indices),
+    )
+    est2.train(linear_dataset(), max_steps=100)
+    manifest2 = json.load(
+        open(os.path.join(est2.model_dir, "checkpoint.json"))
+    )
+    assert manifest2["replay_indices"] == indices
+
+
+def test_report_round_trip(tmp_path):
+    """Reports flow back into the generator
+    (reference: EstimatorReportTest, estimator_test.py:2417-3001)."""
+    seen = []
+
+    class RecordingGenerator(SimpleGenerator):
+        def generate_candidates(
+            self,
+            previous_ensemble,
+            iteration_number,
+            previous_ensemble_reports,
+            all_reports,
+            config=None,
+        ):
+            seen.append(
+                (
+                    iteration_number,
+                    [r.name for r in previous_ensemble_reports],
+                    len(all_reports),
+                )
+            )
+            return super().generate_candidates(
+                previous_ensemble,
+                iteration_number,
+                previous_ensemble_reports,
+                all_reports,
+                config,
+            )
+
+    est = _make_estimator(
+        tmp_path,
+        subnetwork_generator=RecordingGenerator(
+            [
+                DNNBuilder("dnn", 1, with_report=True),
+                DNNBuilder("deep", 2, with_report=True),
+            ]
+        ),
+        max_iterations=2,
+        report_materializer=ReportMaterializer(
+            input_fn=linear_dataset(), steps=2
+        ),
+    )
+    est.train(linear_dataset(), max_steps=100)
+
+    # Generator at iteration 1 must have seen iteration 0's reports.
+    gen_calls = [c for c in seen if c[0] == 1]
+    assert gen_calls
+    assert any(c[1] for c in gen_calls)  # previous_ensemble_reports non-empty
+    reports_file = os.path.join(
+        est.model_dir, "report", "iteration_reports.json"
+    )
+    reports = json.load(open(reports_file))
+    assert set(reports) == {"0", "1"}
+    assert {r["name"] for r in reports["0"]} == {"dnn", "deep"}
+    included = [
+        r["name"] for r in reports["0"] if r["included_in_final_ensemble"]
+    ]
+    assert len(included) == 1
+    assert "mean_logit" in reports["0"][0]["metrics"]
+    assert "loss" in reports["0"][0]["metrics"]
+
+
+def test_nan_candidate_quarantined_in_estimator(tmp_path):
+    est = _make_estimator(
+        tmp_path,
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("good", 1), DNNBuilder("nan", 1, nan_logits=True)]
+        ),
+        max_iterations=1,
+    )
+    est.train(linear_dataset(), max_steps=8)
+    arch = json.load(open(os.path.join(est.model_dir, "architecture-0.json")))
+    assert arch["subnetworks"][0]["builder_name"] == "good"
+
+
+def test_max_iterations_stops_search(tmp_path):
+    est = _make_estimator(tmp_path, max_iterations=1)
+    est.train(linear_dataset(), max_steps=10_000)
+    assert est.latest_iteration_number() == 1
+    assert est.latest_global_step() == 8

@@ -45,11 +45,11 @@ def test_build_iteration_names_and_members():
     )
     names = it.candidate_names()
     assert names == [
-        "t0_dnn_grow",
-        "t0_deep_grow",
-        "t0_dnn_solo",
-        "t0_deep_solo",
-        "t0_all",
+        "t0_dnn_grow_complexity_regularized",
+        "t0_deep_grow_complexity_regularized",
+        "t0_dnn_solo_complexity_regularized",
+        "t0_deep_solo_complexity_regularized",
+        "t0_all_complexity_regularized",
     ]
     all_spec = it.ensemble_specs[-1]
     assert len(all_spec.members) == 2
@@ -65,8 +65,8 @@ def test_train_step_reduces_loss():
         for batch in batches:
             state, metrics = it.train_step(state, batch)
             if first_loss is None:
-                first_loss = float(metrics["adanet_loss/t0_dnn_grow"])
-    final_loss = float(metrics["adanet_loss/t0_dnn_grow"])
+                first_loss = float(metrics["adanet_loss/t0_dnn_grow_complexity_regularized"])
+    final_loss = float(metrics["adanet_loss/t0_dnn_grow_complexity_regularized"])
     assert final_loss < first_loss
     assert int(state.iteration_step) == 20 * len(batches)
     assert int(state.subnetworks["dnn"].step) == 20 * len(batches)
@@ -80,12 +80,12 @@ def test_best_candidate_selection_and_freeze():
     for batch in linear_dataset()():
         state, _ = it.train_step(state, batch)
     emas = it.ema_losses(state)
-    assert emas["t0_nan_grow"] == float("inf")  # quarantined
-    assert np.isfinite(emas["t0_good_grow"])
+    assert emas["t0_nan_grow_complexity_regularized"] == float("inf")  # quarantined
+    assert np.isfinite(emas["t0_good_grow_complexity_regularized"])
     best = it.best_candidate_index(state)
-    assert it.candidate_names()[best] == "t0_good_grow"
+    assert it.candidate_names()[best] == "t0_good_grow_complexity_regularized"
 
-    frozen = it.freeze_candidate(state, "t0_good_grow", _sample_batch())
+    frozen = it.freeze_candidate(state, "t0_good_grow_complexity_regularized", _sample_batch())
     assert frozen.iteration_number == 0
     assert len(frozen.weighted_subnetworks) == 1
     fs = frozen.weighted_subnetworks[0].subnetwork
@@ -112,23 +112,26 @@ def test_second_iteration_grows_on_frozen_ensemble():
     state0 = it0.init_state(jax.random.PRNGKey(0), _sample_batch())
     for batch in linear_dataset()():
         state0, _ = it0.train_step(state0, batch)
-    frozen = it0.freeze_candidate(state0, "t0_dnn_grow", _sample_batch())
+    frozen = it0.freeze_candidate(state0, "t0_dnn_grow_complexity_regularized", _sample_batch())
 
     it1 = builder_factory.build_iteration(
         1, [DNNBuilder("dnn2", 2)], frozen
     )
-    # The grow candidate includes the frozen member + the new builder.
-    spec = it1.ensemble_specs[0]
-    assert spec.name == "t1_dnn2_grow"
+    # Candidate 0 is the carried-over previous ensemble; the grow candidate
+    # (frozen member + new builder) follows.
+    assert it1.ensemble_specs[0].name == frozen.name
+    assert not it1.ensemble_specs[0].track_ema
+    spec = it1.ensemble_specs[1]
+    assert spec.name == "t1_dnn2_grow_complexity_regularized"
     assert len(spec.members) == 2
     assert spec.architecture.subnetworks == ((0, "dnn"), (1, "dnn2"))
 
     state1 = it1.init_state(jax.random.PRNGKey(1), _sample_batch())
     for batch in linear_dataset()():
         state1, metrics = it1.train_step(state1, batch)
-    assert np.isfinite(float(metrics["adanet_loss/t1_dnn2_grow"]))
+    assert np.isfinite(float(metrics["adanet_loss/t1_dnn2_grow_complexity_regularized"]))
 
-    frozen1 = it1.freeze_candidate(state1, "t1_dnn2_grow", _sample_batch())
+    frozen1 = it1.freeze_candidate(state1, "t1_dnn2_grow_complexity_regularized", _sample_batch())
     assert [ws.subnetwork.name for ws in frozen1.weighted_subnetworks] == [
         "dnn",
         "dnn2",
@@ -142,34 +145,40 @@ def test_warm_start_skipped_across_different_ensemblers():
     scalar = ComplexityRegularizedEnsembler(
         optimizer=optax.sgd(0.05), warm_start_mixture_weights=True
     )
-    fac0 = _builder_factory(ensemblers=[scalar])
-    it0 = fac0.build_iteration(0, [DNNBuilder("dnn", 1)], None)
-    state0 = it0.init_state(jax.random.PRNGKey(0), _sample_batch())
-    frozen = it0.freeze_candidate(state0, "t0_dnn_grow", _sample_batch())
-
     matrix = ComplexityRegularizedEnsembler(
         optimizer=optax.sgd(0.05),
         mixture_weight_type=MixtureWeightType.MATRIX,
         warm_start_mixture_weights=True,
         name="matrix",
     )
-    it1 = _builder_factory(ensemblers=[matrix]).build_iteration(
-        1, [DNNBuilder("dnn2", 1)], frozen
+    fac = _builder_factory(ensemblers=[scalar, matrix])
+    it0 = fac.build_iteration(0, [DNNBuilder("dnn", 1)], None)
+    state0 = it0.init_state(jax.random.PRNGKey(0), _sample_batch())
+    frozen = it0.freeze_candidate(
+        state0, "t0_dnn_grow_complexity_regularized", _sample_batch()
     )
+
+    it1 = fac.build_iteration(1, [DNNBuilder("dnn2", 1)], frozen)
     state1 = it1.init_state(jax.random.PRNGKey(1), _sample_batch())
-    # Kept member's weight must be a fresh MATRIX init, not the scalar.
-    w0 = state1.ensembles["t1_dnn2_grow"].params["weights"][0]
+    # The kept member's weight in the MATRIX spec must be a fresh 2-D init,
+    # not the scalar learned by the previous (scalar) ensembler.
+    w0 = state1.ensembles["t1_dnn2_grow_matrix"].params["weights"][0]
     assert w0.ndim == 2
+    # The scalar spec does warm-start from the scalar previous weight.
+    w0s = state1.ensembles["t1_dnn2_grow_complexity_regularized"].params[
+        "weights"
+    ][0]
+    assert w0s.ndim == 0
     state1, metrics = it1.train_step(state1, _sample_batch())
-    assert np.isfinite(float(metrics["adanet_loss/t1_dnn2_grow"]))
+    assert np.isfinite(float(metrics["adanet_loss/t1_dnn2_grow_matrix"]))
 
 
 def test_eval_step_metrics():
     it = _builder_factory().build_iteration(0, [DNNBuilder("dnn", 1)], None)
     state = it.init_state(jax.random.PRNGKey(0), _sample_batch())
     results = it.eval_step(state, _sample_batch())
-    assert "t0_dnn_grow" in results
-    assert "average_loss" in results["t0_dnn_grow"]
+    assert "t0_dnn_grow_complexity_regularized" in results
+    assert "average_loss" in results["t0_dnn_grow_complexity_regularized"]
     assert "subnetwork/dnn" in results
 
 

@@ -303,6 +303,92 @@ class Iteration:
             return out, mutated
         return spec.module.apply(variables, features, training=False), None
 
+    def frozen_outputs(self, frozen_params, features):
+        """Forward passes of the frozen members (callable inside jit)."""
+        return [
+            fs.module.apply(params, features, training=False)
+            for fs, params in zip(self.frozen_subnetworks, frozen_params)
+        ]
+
+    def member_outputs(self, espec, sub_outs, frozen_outs):
+        """Resolves an ensemble spec's member refs to concrete outputs."""
+        return [
+            sub_outs[ref] if kind == _NEW else frozen_outs[ref]
+            for kind, ref in espec.members
+        ]
+
+    def subnetwork_update(self, spec, st, features, labels, dropout_rng):
+        """One subnetwork's forward/backward/update (callable inside jit).
+
+        The analogue of builder.build_subnetwork_train_op execution
+        (reference: adanet/core/ensemble_builder.py:679-805), with the
+        finite-guard quarantine.
+        """
+
+        def loss_fn(p):
+            variables = {**st.variables, "params": p}
+            out, mutated = self._apply_subnetwork(
+                spec, variables, features, True, {"dropout": dropout_rng}
+            )
+            return self.head.loss(out.logits, labels), (out, mutated)
+
+        (loss, (out, mutated)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(st.variables["params"])
+        updates, new_opt = spec.tx.update(
+            grads, st.opt_state, st.variables["params"]
+        )
+        stepped_vars = {
+            **st.variables,
+            **(mutated or {}),
+            "params": optax.apply_updates(st.variables["params"], updates),
+        }
+        ok = jnp.isfinite(loss) & tree_finite(grads) & ~st.dead
+        new_st = SubnetworkTrainState(
+            variables=tree_where(ok, stepped_vars, st.variables),
+            opt_state=tree_where(ok, new_opt, st.opt_state),
+            step=st.step + ok.astype(jnp.int32),
+            dead=st.dead | ~jnp.isfinite(loss),
+        )
+        return new_st, out, loss
+
+    def ensemble_update(self, espec, est, cstate, member_outs, labels):
+        """One ensemble candidate's mixture-weight update (inside jit).
+
+        Gradients are stopped at member outputs, the scoping analogue of
+        reference adanet/core/ensemble_builder.py:301-568.
+        """
+        member_outs = [jax.lax.stop_gradient(o) for o in member_outs]
+
+        def ensemble_loss(p):
+            ens = espec.ensembler.build_ensemble(p, member_outs)
+            loss = self.head.loss(ens.logits, labels)
+            return loss + _complexity_regularization(ens), loss
+
+        if espec.tx is None:
+            adanet_loss, loss = ensemble_loss(est.params)
+            new_est = est
+        else:
+            (adanet_loss, loss), grads = jax.value_and_grad(
+                ensemble_loss, has_aux=True
+            )(est.params)
+            updates, new_opt = espec.tx.update(
+                grads, est.opt_state, est.params
+            )
+            stepped = optax.apply_updates(est.params, updates)
+            ok = jnp.isfinite(adanet_loss) & tree_finite(grads)
+            new_est = EnsembleTrainState(
+                params=tree_where(ok, stepped, est.params),
+                opt_state=tree_where(ok, new_opt, est.opt_state),
+            )
+        if espec.track_ema:
+            new_cstate = candidate_lib.update_candidate_state(
+                cstate, adanet_loss, self.adanet_loss_decay
+            )
+        else:
+            new_cstate = cstate
+        return new_est, new_cstate, adanet_loss, loss
+
     def _train_step_impl(self, state: IterationState, features, labels):
         rng, step_rng = jax.random.split(state.rng)
         metrics: Dict[str, Any] = {}
@@ -313,44 +399,20 @@ class Iteration:
         new_subnetworks = {}
         sub_outs = {}
         for i, spec in enumerate(self.subnetwork_specs):
-            st = state.subnetworks[spec.name]
-            rngs = {"dropout": jax.random.fold_in(step_rng, i)}
-
-            def loss_fn(p, st=st, spec=spec, rngs=rngs):
-                variables = {**st.variables, "params": p}
-                out, mutated = self._apply_subnetwork(
-                    spec, variables, features, True, rngs
-                )
-                return self.head.loss(out.logits, labels), (out, mutated)
-
-            (loss, (out, mutated)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(st.variables["params"])
-            updates, new_opt = spec.tx.update(
-                grads, st.opt_state, st.variables["params"]
+            new_st, out, loss = self.subnetwork_update(
+                spec,
+                state.subnetworks[spec.name],
+                features,
+                labels,
+                jax.random.fold_in(step_rng, i),
             )
-            stepped_vars = {
-                **st.variables,
-                **(mutated or {}),
-                "params": optax.apply_updates(st.variables["params"], updates),
-            }
-            ok = jnp.isfinite(loss) & tree_finite(grads) & ~st.dead
-            new_variables = tree_where(ok, stepped_vars, st.variables)
-            new_subnetworks[spec.name] = SubnetworkTrainState(
-                variables=new_variables,
-                opt_state=tree_where(ok, new_opt, st.opt_state),
-                step=st.step + ok.astype(jnp.int32),
-                dead=st.dead | ~jnp.isfinite(loss),
-            )
+            new_subnetworks[spec.name] = new_st
             sub_outs[spec.name] = out
             metrics["subnetwork_loss/%s" % spec.name] = loss
 
         # 2) Forward the frozen members once, shared by all candidates (the
         #    reference also builds each subnetwork once per graph).
-        frozen_outs = [
-            fs.module.apply(params, features, training=False)
-            for fs, params in zip(self.frozen_subnetworks, state.frozen)
-        ]
+        frozen_outs = self.frozen_outputs(state.frozen, features)
 
         # 3) Train each ensemble candidate's mixture weights on
         #    loss + complexity_regularization, gradients stopped at member
@@ -358,46 +420,16 @@ class Iteration:
         new_ensembles = {}
         new_candidates = {}
         for espec in self.ensemble_specs:
-            member_outs = [
-                jax.lax.stop_gradient(
-                    sub_outs[ref] if kind == _NEW else frozen_outs[ref]
-                )
-                for kind, ref in espec.members
-            ]
-            est = state.ensembles[espec.name]
-
-            def ensemble_loss(p, espec=espec, member_outs=member_outs):
-                ens = espec.ensembler.build_ensemble(p, member_outs)
-                loss = self.head.loss(ens.logits, labels)
-                return loss + _complexity_regularization(ens), loss
-
-            if espec.tx is None:
-                adanet_loss, loss = ensemble_loss(est.params)
-                new_est = est
-            else:
-                (adanet_loss, loss), grads = jax.value_and_grad(
-                    ensemble_loss, has_aux=True
-                )(est.params)
-                updates, new_opt = espec.tx.update(
-                    grads, est.opt_state, est.params
-                )
-                stepped = optax.apply_updates(est.params, updates)
-                ok = jnp.isfinite(adanet_loss) & tree_finite(grads)
-                new_est = EnsembleTrainState(
-                    params=tree_where(ok, stepped, est.params),
-                    opt_state=tree_where(ok, new_opt, est.opt_state),
-                )
+            member_outs = self.member_outputs(espec, sub_outs, frozen_outs)
+            new_est, new_cstate, adanet_loss, loss = self.ensemble_update(
+                espec,
+                state.ensembles[espec.name],
+                state.candidates[espec.name],
+                member_outs,
+                labels,
+            )
             new_ensembles[espec.name] = new_est
-            if espec.track_ema:
-                new_candidates[espec.name] = (
-                    candidate_lib.update_candidate_state(
-                        state.candidates[espec.name],
-                        adanet_loss,
-                        self.adanet_loss_decay,
-                    )
-                )
-            else:
-                new_candidates[espec.name] = state.candidates[espec.name]
+            new_candidates[espec.name] = new_cstate
             metrics["adanet_loss/%s" % espec.name] = adanet_loss
             metrics["ensemble_loss/%s" % espec.name] = loss
 
@@ -427,16 +459,10 @@ class Iteration:
             )
             for spec in self.subnetwork_specs
         }
-        frozen_outs = [
-            fs.module.apply(params, features, training=False)
-            for fs, params in zip(self.frozen_subnetworks, state.frozen)
-        ]
+        frozen_outs = self.frozen_outputs(state.frozen, features)
         results = {}
         for espec in self.ensemble_specs:
-            member_outs = [
-                sub_outs[ref] if kind == _NEW else frozen_outs[ref]
-                for kind, ref in espec.members
-            ]
+            member_outs = self.member_outputs(espec, sub_outs, frozen_outs)
             ens = espec.ensembler.build_ensemble(
                 state.ensembles[espec.name].params, member_outs
             )
@@ -509,14 +535,8 @@ class Iteration:
             )
             for s in self.subnetwork_specs
         }
-        frozen_outs = [
-            fs.module.apply(params, features, training=False)
-            for fs, params in zip(self.frozen_subnetworks, state.frozen)
-        ]
-        member_outs = [
-            sub_outs[ref] if kind == _NEW else frozen_outs[ref]
-            for kind, ref in espec.members
-        ]
+        frozen_outs = self.frozen_outputs(state.frozen, features)
+        member_outs = self.member_outputs(espec, sub_outs, frozen_outs)
         return espec.ensembler.build_ensemble(
             state.ensembles[espec.name].params, member_outs
         )

@@ -1,0 +1,35 @@
+"""Distributed placement: meshes, placement strategies, executors.
+
+TPU-native analogue of the reference `adanet.distributed` package
+(reference: adanet/distributed/__init__.py).
+"""
+
+from adanet_tpu.distributed.executor import RoundRobinExecutor
+from adanet_tpu.distributed.mesh import (
+    batch_sharding,
+    candidate_submeshes,
+    data_parallel_mesh,
+    partition_devices,
+    replicate_state,
+    replicated,
+    shard_batch,
+)
+from adanet_tpu.distributed.placement import (
+    PlacementStrategy,
+    ReplicationStrategy,
+    RoundRobinStrategy,
+)
+
+__all__ = [
+    "PlacementStrategy",
+    "ReplicationStrategy",
+    "RoundRobinExecutor",
+    "RoundRobinStrategy",
+    "batch_sharding",
+    "candidate_submeshes",
+    "data_parallel_mesh",
+    "partition_devices",
+    "replicate_state",
+    "replicated",
+    "shard_batch",
+]

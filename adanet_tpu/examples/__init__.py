@@ -1,0 +1,6 @@
+"""Example search spaces (reference: adanet/examples)."""
+
+from adanet_tpu.examples import simple_cnn
+from adanet_tpu.examples import simple_dnn
+
+__all__ = ["simple_cnn", "simple_dnn"]

@@ -1,0 +1,129 @@
+"""A CIFAR-scale CNN search space (the benchmark workload).
+
+The analogue of the reference's CIFAR CNN generator benchmark config
+(BASELINE.md: "CIFAR-10 CNN subnetwork generator with
+ComplexityRegularizedEnsembler"). TPU-first choices: NHWC layout, bfloat16
+convolution compute with float32 params and loss, channel sizes multiples
+of the MXU lane width where practical.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from adanet_tpu.subnetwork import Builder, Generator, Subnetwork
+
+_NUM_BLOCKS_KEY = "num_blocks"
+
+
+class SimpleCNN(nn.Module):
+    """Conv blocks -> global average pool -> dense, as a `Subnetwork`."""
+
+    logits_dimension: int
+    num_blocks: int
+    channels: int = 64
+    dropout: float = 0.0
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = features["image"] if isinstance(features, dict) else features
+        x = jnp.asarray(x, self.compute_dtype)
+        for i in range(self.num_blocks):
+            x = nn.Conv(
+                self.channels,
+                (3, 3),
+                dtype=self.compute_dtype,
+                name="conv_%d_a" % i,
+            )(x)
+            x = nn.relu(x)
+            x = nn.Conv(
+                self.channels,
+                (3, 3),
+                dtype=self.compute_dtype,
+                name="conv_%d_b" % i,
+            )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = jnp.asarray(x, jnp.float32)
+        if self.dropout > 0:
+            x = nn.Dropout(rate=self.dropout, deterministic=not training)(x)
+        logits = nn.Dense(self.logits_dimension, name="logits")(x)
+        return Subnetwork(
+            last_layer=x,
+            logits=logits,
+            complexity=math.sqrt(max(self.num_blocks, 1)),
+            shared={_NUM_BLOCKS_KEY: self.num_blocks},
+        )
+
+
+class CNNBuilder(Builder):
+    def __init__(
+        self,
+        num_blocks: int,
+        channels: int = 64,
+        learning_rate: float = 0.05,
+        dropout: float = 0.0,
+    ):
+        self._num_blocks = num_blocks
+        self._channels = channels
+        self._learning_rate = learning_rate
+        self._dropout = dropout
+
+    @property
+    def name(self) -> str:
+        return "cnn_%db_%dc" % (self._num_blocks, self._channels)
+
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        return SimpleCNN(
+            logits_dimension=logits_dimension,
+            num_blocks=self._num_blocks,
+            channels=self._channels,
+            dropout=self._dropout,
+        )
+
+    def build_train_optimizer(self, previous_ensemble=None):
+        return optax.sgd(self._learning_rate, momentum=0.9)
+
+
+class CNNGenerator(Generator):
+    """Proposes same-depth and one-deeper CNNs each iteration."""
+
+    def __init__(
+        self,
+        initial_num_blocks: int = 1,
+        channels: int = 64,
+        learning_rate: float = 0.05,
+        dropout: float = 0.0,
+    ):
+        self._initial_num_blocks = initial_num_blocks
+        self._channels = channels
+        self._learning_rate = learning_rate
+        self._dropout = dropout
+
+    def generate_candidates(
+        self,
+        previous_ensemble,
+        iteration_number,
+        previous_ensemble_reports,
+        all_reports,
+        config=None,
+    ) -> List[Builder]:
+        num_blocks = self._initial_num_blocks
+        if previous_ensemble:
+            last = previous_ensemble.weighted_subnetworks[-1].subnetwork
+            shared = last.shared or {}
+            num_blocks = int(shared.get(_NUM_BLOCKS_KEY, num_blocks))
+        make = lambda blocks: CNNBuilder(
+            num_blocks=blocks,
+            channels=self._channels,
+            learning_rate=self._learning_rate,
+            dropout=self._dropout,
+        )
+        return [make(num_blocks), make(num_blocks + 1)]

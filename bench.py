@@ -1,0 +1,108 @@
+"""Benchmark: examples/sec/chip for one AdaNet iteration (CIFAR CNN config).
+
+Runs the BASELINE.md "CIFAR-10 CNN subnetwork generator +
+ComplexityRegularizedEnsembler" configuration on the available accelerator:
+one full AdaNet iteration step (two CNN candidates' forward/backward +
+mixture-weight update, all in one jitted XLA program) on synthetic
+CIFAR-10-shaped data, measuring examples/sec/chip.
+
+The reference publishes no throughput numbers (BASELINE.md: "not
+published"), so `vs_baseline` is computed against a fixed estimate of the
+reference's per-worker throughput on its benchmark cluster (NVIDIA P100,
+TF-1.x Estimator, batch 32/worker — research/improve_nas/config.yaml): a
+P100 sustains roughly 1.5k examples/sec on a comparable two-candidate CNN
+training graph. The constant is pinned so round-over-round changes in
+`value` are directly comparable.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import optax
+
+# Pinned estimate of reference per-GPU throughput for this workload (see
+# module docstring); not a measured number, but fixed across rounds.
+P100_REFERENCE_EXAMPLES_PER_SEC = 1500.0
+
+BATCH_SIZE = 256
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main():
+    from adanet_tpu.core.heads import MultiClassHead
+    from adanet_tpu.core.iteration import IterationBuilder
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler, GrowStrategy
+    from adanet_tpu.examples.simple_cnn import CNNBuilder
+
+    from adanet_tpu.distributed import (
+        data_parallel_mesh,
+        replicate_state,
+        shard_batch,
+    )
+
+    factory = IterationBuilder(
+        head=MultiClassHead(n_classes=10),
+        ensemblers=[
+            ComplexityRegularizedEnsembler(
+                optimizer=optax.sgd(0.01), adanet_lambda=0.001
+            )
+        ],
+        ensemble_strategies=[GrowStrategy()],
+    )
+    builders = [
+        CNNBuilder(num_blocks=2, channels=64),
+        CNNBuilder(num_blocks=3, channels=64),
+    ]
+    iteration = factory.build_iteration(0, builders, None)
+
+    # Shard the batch over all chips (per-chip batch = BATCH_SIZE) so the
+    # per-chip figure stays honest on multi-chip hosts.
+    num_chips = jax.device_count()
+    mesh = data_parallel_mesh()
+    rng = np.random.RandomState(0)
+    global_batch = BATCH_SIZE * num_chips
+    batch = (
+        {"image": rng.randn(global_batch, 32, 32, 3).astype(np.float32)},
+        rng.randint(0, 10, size=(global_batch,)),
+    )
+    batch = shard_batch(batch, mesh)
+    state = iteration.init_state(jax.random.PRNGKey(0), batch)
+    state = replicate_state(state, mesh)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = iteration.train_step(state, batch)
+    jax.block_until_ready(metrics)
+
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = iteration.train_step(state, batch)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - start
+
+    examples_per_sec_per_chip = (
+        MEASURE_STEPS * global_batch / elapsed / num_chips
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "adanet_iteration_examples_per_sec_per_chip",
+                "value": round(examples_per_sec_per_chip, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(
+                    examples_per_sec_per_chip
+                    / P100_REFERENCE_EXAMPLES_PER_SEC,
+                    3,
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,15 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
-Must set the XLA flags before jax initializes; tests exercise all sharding
-paths on virtual CPU devices (the analogue of the reference's TF_CONFIG
-localhost clusters, reference: adanet/core/estimator_distributed_test.py).
+Tests exercise all sharding paths on virtual CPU devices (the analogue of
+the reference's TF_CONFIG localhost clusters,
+reference: adanet/core/estimator_distributed_test.py).
+
+NOTE: this environment preloads jax via a sitecustomize hook before pytest
+imports this file, so env vars alone are too late — the jax config values
+must be updated directly (backends are still uninitialized at this point).
 """
 
-import os
+import jax
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -8,9 +8,13 @@ complexity-regularized mixture weights, select the best ensemble, and grow.
 Top-level API mirrors the reference `adanet/__init__.py`.
 """
 
+from adanet_tpu import distributed
 from adanet_tpu import ensemble
 from adanet_tpu import replay
 from adanet_tpu import subnetwork
+from adanet_tpu.autoensemble import AutoEnsembleEstimator
+from adanet_tpu.autoensemble import AutoEnsembleSubestimator
+from adanet_tpu.autoensemble import AutoEnsembleTPUEstimator
 from adanet_tpu.core.estimator import Estimator
 from adanet_tpu.core.evaluator import Evaluator
 from adanet_tpu.core.evaluator import Objective
@@ -28,9 +32,13 @@ from adanet_tpu.subnetwork import Subnetwork
 __version__ = "0.1.0"
 
 __all__ = [
+    "AutoEnsembleEstimator",
+    "AutoEnsembleSubestimator",
+    "AutoEnsembleTPUEstimator",
     "BinaryClassificationHead",
     "Builder",
     "Estimator",
+    "distributed",
     "Evaluator",
     "Generator",
     "Head",

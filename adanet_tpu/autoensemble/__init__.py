@@ -1,0 +1,15 @@
+"""AutoEnsemble: automatically ensemble arbitrary user models.
+
+TPU-native analogue of the reference `adanet.autoensemble` package
+(reference: adanet/autoensemble/__init__.py).
+"""
+
+from adanet_tpu.autoensemble.common import AutoEnsembleSubestimator
+from adanet_tpu.autoensemble.estimator import AutoEnsembleEstimator
+from adanet_tpu.autoensemble.estimator import AutoEnsembleTPUEstimator
+
+__all__ = [
+    "AutoEnsembleEstimator",
+    "AutoEnsembleSubestimator",
+    "AutoEnsembleTPUEstimator",
+]

@@ -1,0 +1,165 @@
+"""Adapters that turn plain user models into AdaNet candidates.
+
+Analogue of the reference autoensemble internals
+(reference: adanet/autoensemble/common.py:31-268). The reference wraps
+`tf.estimator.Estimator`s by re-running their `model_fn` inside templates;
+here a candidate is any Flax module whose `__call__(features, training)`
+returns logits (or a dict of them), paired with an optax optimizer — the
+wrapper adapts it into a `Builder` producing a `Subnetwork` with
+complexity 0 (reference hardcodes 0, common.py:188).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import optax
+
+from adanet_tpu.subnetwork import Builder, Generator, Subnetwork
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoEnsembleSubestimator:
+    """A candidate model with optional dedicated training data.
+
+    Analogue of reference `AutoEnsembleSubestimator`
+    (reference: adanet/autoensemble/common.py:59-93).
+
+    Attributes:
+      module: Flax module; `module.apply(vars, features, training=...)`
+        returns logits (array or dict of head-name to array) or a
+        `Subnetwork`.
+      optimizer: optax transform training this candidate (ignored when
+        `prediction_only`).
+      train_input_fn: optional zero-arg callable yielding (features, labels)
+        batches used ONLY by this candidate — per-candidate data enables
+        bagging (reference: common.py:76-88).
+      prediction_only: freeze the candidate; only use it for inference
+        (reference: common.py:89-92).
+      logits_fn: optional fn mapping the module's output to logits, for
+        modules with richer outputs (reference `logits_fn`, common.py:31-40).
+      last_layer_fn: optional fn mapping the module's output to the last
+        hidden layer (reference `last_layer_fn`).
+    """
+
+    module: Any
+    optimizer: Optional[Any] = None
+    train_input_fn: Optional[Callable] = None
+    prediction_only: bool = False
+    logits_fn: Optional[Callable] = None
+    last_layer_fn: Optional[Callable] = None
+
+
+def _make_wrapper_module(subestimator: AutoEnsembleSubestimator):
+    import flax.linen as nn
+
+    class _AutoSubnetwork(nn.Module):
+        """Adapts a plain-logits module into a `Subnetwork` producer."""
+
+        inner: Any
+
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            out = self.inner(features, training=training)
+            if isinstance(out, Subnetwork):
+                return out
+            logits = out
+            if subestimator.logits_fn is not None:
+                logits = subestimator.logits_fn(out)
+            last_layer = logits
+            if subestimator.last_layer_fn is not None:
+                last_layer = subestimator.last_layer_fn(out)
+            # Complexity hardcoded to 0, matching reference common.py:188.
+            return Subnetwork(
+                last_layer=last_layer, logits=logits, complexity=0.0
+            )
+
+    return _AutoSubnetwork(inner=subestimator.module)
+
+
+class _BuilderFromSubestimator(Builder):
+    """Builds the candidate's subnetwork from a wrapped module.
+
+    Analogue of reference `_BuilderFromSubestimator`
+    (reference: adanet/autoensemble/common.py:96-198).
+    """
+
+    def __init__(self, name: str, subestimator: AutoEnsembleSubestimator):
+        self._name = name
+        self._subestimator = subestimator
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def train_input_fn(self):
+        return self._subestimator.train_input_fn
+
+    @property
+    def prediction_only(self) -> bool:
+        return self._subestimator.prediction_only
+
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        del logits_dimension  # the user module owns its output width
+        return _make_wrapper_module(self._subestimator)
+
+    def build_train_optimizer(self, previous_ensemble=None):
+        if self._subestimator.prediction_only:
+            # Zero-update transform: the candidate participates in
+            # ensembles but its weights never move.
+            return optax.set_to_zero()
+        return self._subestimator.optimizer or optax.sgd(0.01)
+
+
+def _normalize_pool(
+    candidate_pool, iteration_number: int
+) -> Dict[str, AutoEnsembleSubestimator]:
+    """dict/list/callable pool -> name->Subestimator dict.
+
+    Reference semantics: adanet/autoensemble/common.py:201-216 (dict keys
+    become names; lists use the class name + index; callables receive
+    (config, iteration_number)).
+    """
+    if callable(candidate_pool) and not isinstance(candidate_pool, dict):
+        candidate_pool = candidate_pool(iteration_number=iteration_number)
+    normalized: Dict[str, AutoEnsembleSubestimator] = {}
+    if isinstance(candidate_pool, dict):
+        items = sorted(candidate_pool.items())
+    else:
+        items = [
+            ("candidate_%d" % i, c) for i, c in enumerate(candidate_pool)
+        ]
+    for name, cand in items:
+        if not isinstance(cand, AutoEnsembleSubestimator):
+            cand = AutoEnsembleSubestimator(module=cand)
+        normalized[name] = cand
+    return normalized
+
+
+class _GeneratorFromCandidatePool(Generator):
+    """Regenerates the candidate pool's builders each iteration.
+
+    Analogue of reference `_GeneratorFromCandidatePool`
+    (reference: adanet/autoensemble/common.py:218-268).
+    """
+
+    def __init__(self, candidate_pool):
+        self._candidate_pool = candidate_pool
+
+    def generate_candidates(
+        self,
+        previous_ensemble,
+        iteration_number,
+        previous_ensemble_reports,
+        all_reports,
+        config=None,
+    ) -> List[Builder]:
+        del previous_ensemble, previous_ensemble_reports, all_reports, config
+        pool = _normalize_pool(self._candidate_pool, iteration_number)
+        return [
+            _BuilderFromSubestimator(name, sub)
+            for name, sub in pool.items()
+        ]

@@ -1,0 +1,49 @@
+"""AutoEnsembleEstimator: ensemble arbitrary user models automatically.
+
+Analogue of the reference `AutoEnsembleEstimator`
+(reference: adanet/autoensemble/estimator.py:28-220): an `adanet.Estimator`
+whose generator wraps a fixed pool of user models. Since the engine is
+TPU-native throughout, this single class also covers the reference's
+`AutoEnsembleTPUEstimator` (estimator.py:223-414) — there is no separate
+TPU code path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from adanet_tpu.autoensemble.common import _GeneratorFromCandidatePool
+from adanet_tpu.core.estimator import Estimator
+
+
+class AutoEnsembleEstimator(Estimator):
+    """Learns to ensemble a pool of user models.
+
+    Args:
+      head: a `Head`.
+      candidate_pool: dict of name -> candidate, list of candidates, or
+        callable `(iteration_number) -> pool`. A candidate is an
+        `AutoEnsembleSubestimator`, or a bare Flax module (wrapped with
+        default optimizer).
+      max_iteration_steps: steps per AdaNet iteration.
+      **kwargs: forwarded to `adanet_tpu.Estimator` (ensemblers,
+        ensemble_strategies, evaluator, force_grow, model_dir, ...).
+    """
+
+    def __init__(
+        self,
+        head,
+        candidate_pool,
+        max_iteration_steps: int,
+        **kwargs,
+    ):
+        super().__init__(
+            head=head,
+            subnetwork_generator=_GeneratorFromCandidatePool(candidate_pool),
+            max_iteration_steps=max_iteration_steps,
+            **kwargs,
+        )
+
+
+# The engine is TPU-native; the reference's separate TPU class is an alias.
+AutoEnsembleTPUEstimator = AutoEnsembleEstimator

@@ -43,6 +43,7 @@ from adanet_tpu.core.frozen import (
 from adanet_tpu.core.iteration import Iteration, IterationBuilder
 from adanet_tpu.core.report_accessor import ReportAccessor
 from adanet_tpu.core.report_materializer import ReportMaterializer
+from adanet_tpu.core.summary import ScopedSummary
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
 
@@ -100,6 +101,7 @@ class Estimator:
         random_seed: int = 42,
         save_checkpoint_steps: Optional[int] = None,
         log_every_steps: int = 100,
+        enable_summaries: bool = True,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -127,6 +129,8 @@ class Estimator:
         self._random_seed = int(random_seed)
         self._save_checkpoint_steps = save_checkpoint_steps
         self._log_every_steps = int(log_every_steps)
+        self._enable_summaries = bool(enable_summaries)
+        self._summary: Optional[ScopedSummary] = None
 
         self._iteration_builder = IterationBuilder(
             head=head,
@@ -196,6 +200,15 @@ class Estimator:
             )
             state = self._init_or_restore_state(iteration, sample_batch, info)
 
+            # Candidates with dedicated training data (bagging; reference:
+            # adanet/autoensemble/common.py:59-93) get their own iterators.
+            extra_input_fns = {
+                spec.name: spec.builder.train_input_fn
+                for spec in iteration.subnetwork_specs
+                if getattr(spec.builder, "train_input_fn", None) is not None
+            }
+            extra_iters: Dict[str, Iterator] = {}
+
             steps_done = int(jax.device_get(state.iteration_step))
             _LOG.info(
                 "Starting iteration %d at iteration_step %d "
@@ -209,7 +222,14 @@ class Estimator:
                 max_steps is None or info.global_step < max_steps
             ):
                 batch, data_iter = self._next_batch(input_fn, data_iter)
-                state, metrics = iteration.train_step(state, batch)
+                extra_batches = {}
+                for name, fn in extra_input_fns.items():
+                    extra_batches[name], extra_iters[name] = (
+                        self._next_batch(fn, extra_iters.get(name))
+                    )
+                state, metrics = iteration.train_step(
+                    state, batch, extra_batches
+                )
                 steps_done += 1
                 info.global_step += 1
                 if (
@@ -223,6 +243,9 @@ class Estimator:
                         steps_done,
                         self._max_iteration_steps,
                         {k: round(v, 6) for k, v in emas.items()},
+                    )
+                    self._write_train_summaries(
+                        iteration, metrics, emas, info.global_step
                     )
                 if (
                     self._save_checkpoint_steps
@@ -252,6 +275,43 @@ class Estimator:
                 return next(data_iter), data_iter
             except StopIteration:
                 raise ValueError("input_fn yielded no batches.")
+
+    def _write_train_summaries(self, iteration, metrics, emas, global_step):
+        """Scoped per-candidate TensorBoard scalars.
+
+        Layout mirrors the reference's candidate-scoped event dirs
+        (reference: adanet/core/summary.py:213-373,
+        docs/source/tensorboard.md): <model_dir>/ensemble/<name> and
+        <model_dir>/subnetwork/<name>, with unscoped tags so identically
+        named metrics overlay across candidates.
+        """
+        if not self._enable_summaries:
+            return
+        if self._summary is None:
+            self._summary = ScopedSummary(self._model_dir)
+        host = jax.device_get(metrics)
+        for spec in iteration.ensemble_specs:
+            values = {
+                "adanet_loss": host.get("adanet_loss/%s" % spec.name),
+                "loss": host.get("ensemble_loss/%s" % spec.name),
+                "adanet_loss_ema": emas.get(spec.name),
+            }
+            self._summary.scalars(
+                "ensemble",
+                spec.name,
+                {k: v for k, v in values.items() if v is not None},
+                global_step,
+            )
+        for spec in iteration.subnetwork_specs:
+            loss = host.get("subnetwork_loss/%s" % spec.name)
+            if loss is not None:
+                self._summary.scalars(
+                    "subnetwork",
+                    "t%d_%s" % (iteration.iteration_number, spec.name),
+                    {"loss": loss},
+                    global_step,
+                )
+        self._summary.flush()
 
     def _iteration_rng(self, iteration_number: int):
         return jax.random.fold_in(
@@ -493,6 +553,10 @@ class Estimator:
         info.iteration_state_file = None
         info.replay_indices = frozen.architecture.replay_indices
         ckpt_lib.write_manifest(self._model_dir, info)
+        if self._summary is not None:
+            # Scopes are per-iteration (t<N>_...); close them so open file
+            # handles stay bounded across long searches.
+            self._summary.close()
 
     # ------------------------------------------------------- evaluate/predict
 

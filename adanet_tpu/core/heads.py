@@ -53,6 +53,18 @@ def _weighted_mean(values, weights):
     return jnp.sum(values * weights) / jnp.maximum(jnp.sum(weights), 1e-12)
 
 
+def _check_logits_dimension(logits, expected: int, head_name: str) -> None:
+    """Trace-time shape validation: logits shapes are static under jit, so a
+    plain Python check catches mismatched subnetwork output widths instead
+    of silently mis-training (e.g. XLA clamps out-of-range label gathers).
+    Rank-1 `(batch,)` logits (squeezed single-output) are accepted as-is."""
+    if logits.ndim >= 2 and logits.shape[-1] != expected:
+        raise ValueError(
+            "%s expects logits with last dimension %d, got shape %s"
+            % (head_name, expected, tuple(logits.shape))
+        )
+
+
 class RegressionHead(Head):
     """Mean squared error regression head."""
 
@@ -65,6 +77,7 @@ class RegressionHead(Head):
         return self._label_dimension
 
     def loss(self, logits, labels, weights=None):
+        _check_logits_dimension(logits, self._label_dimension, self.name)
         labels = jnp.reshape(
             jnp.asarray(labels, jnp.float32), logits.shape
         )
@@ -92,6 +105,7 @@ class BinaryClassificationHead(Head):
 
     def loss(self, logits, labels, weights=None):
         logits = jnp.asarray(logits, jnp.float32)
+        _check_logits_dimension(logits, 1, self.name)
         labels = jnp.reshape(jnp.asarray(labels, jnp.float32), logits.shape)
         per_example = jnp.mean(
             optax.sigmoid_binary_cross_entropy(logits, labels), axis=-1
@@ -140,6 +154,7 @@ class MultiClassHead(Head):
 
     def loss(self, logits, labels, weights=None):
         logits = jnp.asarray(logits, jnp.float32)
+        _check_logits_dimension(logits, self._n_classes, self.name)
         labels = jnp.reshape(jnp.asarray(labels, jnp.int32), (-1,))
         per_example = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels

@@ -284,10 +284,15 @@ class Iteration:
 
     # ----------------------------------------------------------------- train
 
-    def train_step(self, state: IterationState, batch):
-        """One jitted step over every candidate. Returns (state, metrics)."""
-        features, labels = batch
-        return self._train_step(state, features, labels)
+    def train_step(self, state: IterationState, batch, extra_batches=None):
+        """One jitted step over every candidate. Returns (state, metrics).
+
+        `batch` is the shared (features, labels) tuple; `extra_batches`
+        optionally maps subnetwork names to dedicated (features, labels) —
+        per-candidate training data is how AutoEnsemble implements bagging
+        (reference: adanet/autoensemble/common.py:59-93).
+        """
+        return self._train_step(state, batch, dict(extra_batches or {}))
 
     def _apply_subnetwork(
         self, spec, variables, features, training, rngs=None
@@ -389,23 +394,38 @@ class Iteration:
             new_cstate = cstate
         return new_est, new_cstate, adanet_loss, loss
 
-    def _train_step_impl(self, state: IterationState, features, labels):
+    def _train_step_impl(self, state: IterationState, batch, extra_batches):
+        features, labels = batch
         rng, step_rng = jax.random.split(state.rng)
         metrics: Dict[str, Any] = {}
 
         # 1) Train every new subnetwork on its own head loss (the analogue of
         #    builder.build_subnetwork_train_op; reference:
-        #    adanet/core/ensemble_builder.py:679-805).
+        #    adanet/core/ensemble_builder.py:679-805). Subnetworks with their
+        #    own batch (bagging) train on it; their ensemble-facing forward
+        #    uses the shared default batch.
         new_subnetworks = {}
         sub_outs = {}
         for i, spec in enumerate(self.subnetwork_specs):
+            own_features, own_labels = extra_batches.get(
+                spec.name, (features, labels)
+            )
             new_st, out, loss = self.subnetwork_update(
                 spec,
                 state.subnetworks[spec.name],
-                features,
-                labels,
+                own_features,
+                own_labels,
                 jax.random.fold_in(step_rng, i),
             )
+            if spec.name in extra_batches:
+                # Recompute the forward on the shared batch for ensembles.
+                out, _ = self._apply_subnetwork(
+                    spec,
+                    new_st.variables,
+                    features,
+                    True,
+                    {"dropout": jax.random.fold_in(step_rng, 1000 + i)},
+                )
             new_subnetworks[spec.name] = new_st
             sub_outs[spec.name] = out
             metrics["subnetwork_loss/%s" % spec.name] = loss

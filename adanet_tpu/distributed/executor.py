@@ -53,6 +53,8 @@ class RoundRobinExecutor:
         self.iteration = iteration
         self.strategy = strategy or RoundRobinStrategy()
         self.sync_every = int(sync_every)
+        self._host_step = 0
+        self._member_vars_cache = None
 
         n = len(iteration.subnetwork_specs)
         self._n = n
@@ -166,10 +168,9 @@ class RoundRobinExecutor:
             metrics["subnetwork_loss/%s" % spec.name] = loss
 
         # Host-side counter avoids a device sync in the dispatch loop.
-        step_index = getattr(self, "_host_step", 0)
+        step_index = self._host_step
         self._host_step = step_index + 1
-        sync = step_index % self.sync_every == 0
-        if sync or not hasattr(self, "_member_vars_cache"):
+        if step_index % self.sync_every == 0 or self._member_vars_cache is None:
             # ICI transfer of member params to the ensemble submesh — the
             # analogue of PS variable fetches.
             self._member_vars_cache = {

@@ -44,6 +44,7 @@ from adanet_tpu.core.iteration import Iteration, IterationBuilder
 from adanet_tpu.core.report_accessor import ReportAccessor
 from adanet_tpu.core.report_materializer import ReportMaterializer
 from adanet_tpu.core.summary import ScopedSummary
+from adanet_tpu.distributed import coordination
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
 
@@ -102,6 +103,7 @@ class Estimator:
         save_checkpoint_steps: Optional[int] = None,
         log_every_steps: int = 100,
         enable_summaries: bool = True,
+        worker_wait_timeout_secs: float = 7200.0,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -131,6 +133,7 @@ class Estimator:
         self._log_every_steps = int(log_every_steps)
         self._enable_summaries = bool(enable_summaries)
         self._summary: Optional[ScopedSummary] = None
+        self._worker_wait_timeout_secs = float(worker_wait_timeout_secs)
 
         self._iteration_builder = IterationBuilder(
             head=head,
@@ -235,6 +238,7 @@ class Estimator:
                 if (
                     self._log_every_steps
                     and steps_done % self._log_every_steps == 0
+                    and coordination.is_chief()
                 ):
                     emas = iteration.ema_losses(state)
                     _LOG.info(
@@ -250,17 +254,29 @@ class Estimator:
                 if (
                     self._save_checkpoint_steps
                     and steps_done % self._save_checkpoint_steps == 0
+                    and coordination.is_chief()
                 ):
                     self._save_iteration_state(info, t, state)
 
             if steps_done < self._max_iteration_steps:
                 # Interrupted by max_steps: persist mid-iteration and stop.
-                self._save_iteration_state(info, t, state)
+                if coordination.is_chief():
+                    self._save_iteration_state(info, t, state)
                 break
 
-            cached_previous = self._complete_iteration(
-                iteration, state, sample_batch, info
-            )
+            if coordination.is_chief():
+                cached_previous = self._complete_iteration(
+                    iteration, state, sample_batch, info
+                )
+            else:
+                # Workers wait for the chief's bookkeeping phase to advance
+                # the manifest (reference: estimator.py:951-984).
+                info = coordination.wait_for_iteration(
+                    self._model_dir,
+                    t + 1,
+                    timeout_secs=self._worker_wait_timeout_secs,
+                )
+                cached_previous = None
 
         return self
 

@@ -118,6 +118,21 @@ def _complexity_regularization(ensemble):
     return getattr(ensemble, "complexity_regularization", 0.0)
 
 
+@struct.dataclass
+class TrainLossContext:
+    """Teacher signals available to `Builder.build_subnetwork_loss`.
+
+    `previous_ensemble_logits`: the frozen previous ensemble's logits on the
+    current batch (ADAPTIVE knowledge distillation; reference:
+    research/improve_nas/trainer/improve_nas.py:166-172).
+    `previous_subnetwork_logits`: the most recent frozen member's logits
+    (BORN_AGAIN distillation; reference: improve_nas.py:174-180).
+    """
+
+    previous_ensemble_logits: Any = None
+    previous_subnetwork_logits: Any = None
+
+
 class Iteration:
     """One AdaNet iteration: candidates, jitted steps, and state management."""
 
@@ -322,12 +337,16 @@ class Iteration:
             for kind, ref in espec.members
         ]
 
-    def subnetwork_update(self, spec, st, features, labels, dropout_rng):
+    def subnetwork_update(
+        self, spec, st, features, labels, dropout_rng, loss_context=None
+    ):
         """One subnetwork's forward/backward/update (callable inside jit).
 
         The analogue of builder.build_subnetwork_train_op execution
         (reference: adanet/core/ensemble_builder.py:679-805), with the
-        finite-guard quarantine.
+        finite-guard quarantine. When the builder overrides
+        `build_subnetwork_loss`, that custom loss trains the subnetwork
+        (knowledge distillation, auxiliary heads, label smoothing, ...).
         """
 
         def loss_fn(p):
@@ -335,7 +354,12 @@ class Iteration:
             out, mutated = self._apply_subnetwork(
                 spec, variables, features, True, {"dropout": dropout_rng}
             )
-            return self.head.loss(out.logits, labels), (out, mutated)
+            loss = spec.builder.build_subnetwork_loss(
+                out, labels, self.head, loss_context
+            )
+            if loss is None:
+                loss = self.head.loss(out.logits, labels)
+            return loss, (out, mutated)
 
         (loss, (out, mutated)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -399,6 +423,34 @@ class Iteration:
         rng, step_rng = jax.random.split(state.rng)
         metrics: Dict[str, Any] = {}
 
+        # 0) Forward the frozen members once, shared by all candidates (the
+        #    reference also builds each subnetwork once per graph), and
+        #    derive the distillation teacher signals.
+        frozen_outs = self.frozen_outputs(state.frozen, features)
+
+        def make_loss_context(batch_features, shared_frozen_outs=None):
+            if not self.frozen_subnetworks or self.previous_ensemble is None:
+                return None
+            outs = (
+                shared_frozen_outs
+                if shared_frozen_outs is not None
+                else self.frozen_outputs(state.frozen, batch_features)
+            )
+            prev_spec = self.ensemble_specs[0]
+            prev_ensemble = prev_spec.ensembler.build_ensemble(
+                state.ensembles[prev_spec.name].params, outs
+            )
+            return TrainLossContext(
+                previous_ensemble_logits=jax.lax.stop_gradient(
+                    prev_ensemble.logits
+                ),
+                previous_subnetwork_logits=jax.lax.stop_gradient(
+                    outs[-1].logits
+                ),
+            )
+
+        loss_context = make_loss_context(features, frozen_outs)
+
         # 1) Train every new subnetwork on its own head loss (the analogue of
         #    builder.build_subnetwork_train_op; reference:
         #    adanet/core/ensemble_builder.py:679-805). Subnetworks with their
@@ -410,12 +462,20 @@ class Iteration:
             own_features, own_labels = extra_batches.get(
                 spec.name, (features, labels)
             )
+            # Bagged specs (own batch) get teacher signals recomputed on
+            # their own features so distillation pairs matching examples.
+            spec_context = (
+                make_loss_context(own_features)
+                if spec.name in extra_batches
+                else loss_context
+            )
             new_st, out, loss = self.subnetwork_update(
                 spec,
                 state.subnetworks[spec.name],
                 own_features,
                 own_labels,
                 jax.random.fold_in(step_rng, i),
+                loss_context=spec_context,
             )
             if spec.name in extra_batches:
                 # Recompute the forward on the shared batch for ensembles.
@@ -430,11 +490,7 @@ class Iteration:
             sub_outs[spec.name] = out
             metrics["subnetwork_loss/%s" % spec.name] = loss
 
-        # 2) Forward the frozen members once, shared by all candidates (the
-        #    reference also builds each subnetwork once per graph).
-        frozen_outs = self.frozen_outputs(state.frozen, features)
-
-        # 3) Train each ensemble candidate's mixture weights on
+        # 2) Train each ensemble candidate's mixture weights on
         #    loss + complexity_regularization, gradients stopped at member
         #    outputs (reference: adanet/core/ensemble_builder.py:301-568).
         new_ensembles = {}
@@ -594,19 +650,22 @@ class Iteration:
                 spec = next(
                     s for s in self.subnetwork_specs if s.name == ref
                 )
-                variables = jax.device_get(
-                    state.subnetworks[spec.name].variables
-                )
+                device_variables = state.subnetworks[spec.name].variables
                 # Record concrete complexity/shared for host-side consumers
-                # (e.g. simple_dnn reading previous depth from `shared`).
-                out = jax.device_get(
-                    spec.module.apply(variables, features, training=False)
-                )
+                # (e.g. simple_dnn reading previous depth from `shared`);
+                # jitted so freezing doesn't fall back to op-by-op eager
+                # execution of the whole subnetwork.
+                out = jax.jit(
+                    lambda v, f, m=spec.module: m.apply(
+                        v, f, training=False
+                    )
+                )(device_variables, features)
+                out = jax.device_get(out)
                 frozen = FrozenSubnetwork(
                     iteration_number=self.iteration_number,
                     name=spec.name,
                     module=spec.module,
-                    params=variables,
+                    params=jax.device_get(device_variables),
                     complexity=out.complexity,
                     shared=out.shared,
                 )

@@ -4,6 +4,12 @@ TPU-native analogue of the reference `adanet.distributed` package
 (reference: adanet/distributed/__init__.py).
 """
 
+from adanet_tpu.distributed.coordination import (
+    WorkerWaitTimeout,
+    initialize,
+    is_chief,
+    wait_for_iteration,
+)
 from adanet_tpu.distributed.executor import RoundRobinExecutor
 from adanet_tpu.distributed.mesh import (
     batch_sharding,
@@ -25,6 +31,10 @@ __all__ = [
     "ReplicationStrategy",
     "RoundRobinExecutor",
     "RoundRobinStrategy",
+    "WorkerWaitTimeout",
+    "initialize",
+    "is_chief",
+    "wait_for_iteration",
     "batch_sharding",
     "candidate_submeshes",
     "data_parallel_mesh",

@@ -1,0 +1,93 @@
+"""Multi-host coordination: process roles and the checkpoint handshake.
+
+TPU-native analogue of the reference's chief/worker coordination
+(reference: adanet/core/estimator.py:937-999 and SURVEY.md §5.3): workers
+never run the bookkeeping phase; they poll the durable checkpoint manifest
+until the chief advances the iteration number, with a countdown timeout
+after which they exit gracefully (reference `worker_wait_timeout_secs`,
+default 7200s, estimator.py:951-984).
+
+Multi-host initialization rides `jax.distributed.initialize` (the JAX
+runtime's ICI/DCN bootstrap, replacing the reference's TF_CONFIG gRPC
+cluster). This module is the host-side control plane only. In the current
+Estimator, non-chief processes train independent replicas whose state is
+discarded at iteration boundaries in favor of the chief's checkpoint —
+redundant compute used purely for fault tolerance, weaker than the
+reference's PS aggregation. True multi-host SPMD (global batch sharded
+across processes, gradient psums over ICI/DCN via globally sharded arrays)
+is the planned data path; the mesh/sharding layer in
+`adanet_tpu.distributed.mesh` already expresses it within one process.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+
+from adanet_tpu.core import checkpoint as ckpt_lib
+from adanet_tpu.core.timer import CountDownTimer
+
+_LOG = logging.getLogger("adanet_tpu")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initializes the JAX distributed runtime (multi-host).
+
+    A no-op for single-process runs. The analogue of TF_CONFIG cluster
+    bootstrap (reference: adanet/core/estimator_distributed_test.py:46-88).
+    """
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_chief() -> bool:
+    """Process 0 runs bookkeeping (selection, reports, checkpoints)."""
+    return jax.process_index() == 0
+
+
+class WorkerWaitTimeout(TimeoutError):
+    """The chief did not advance the iteration within the timeout."""
+
+
+def wait_for_iteration(
+    model_dir: str,
+    iteration_number: int,
+    timeout_secs: float = 7200.0,
+    poll_interval_secs: float = 1.0,
+) -> ckpt_lib.CheckpointInfo:
+    """Blocks until the manifest reaches `iteration_number`.
+
+    The worker side of the reference's filesystem handshake
+    (estimator.py:951-984): poll the checkpoint until the chief's
+    bookkeeping phase increments the iteration, then return the manifest.
+    Raises `WorkerWaitTimeout` after `timeout_secs` (the reference logs and
+    exits gracefully; callers may catch and do the same).
+    """
+    timer = CountDownTimer(timeout_secs)
+    while True:
+        info = ckpt_lib.read_manifest(model_dir)
+        if info is not None and info.iteration_number >= iteration_number:
+            return info
+        if timer.secs_remaining() <= 0:
+            raise WorkerWaitTimeout(
+                "Gave up waiting for the chief to write iteration %d to %s "
+                "after %.0fs." % (iteration_number, model_dir, timeout_secs)
+            )
+        _LOG.debug(
+            "Waiting for chief to finish iteration %d (%.0fs remaining)",
+            iteration_number - 1,
+            timer.secs_remaining(),
+        )
+        time.sleep(poll_interval_secs)

@@ -105,6 +105,11 @@ class ComplexityRegularizedEnsembler(Ensembler):
       adanet_beta: beta >= 0, uniform L1 penalty on all members.
       use_bias: whether to add a trainable bias term to the ensemble logits.
       name: optional name, defaults to "complexity_regularized".
+      use_fused_combine: use the Pallas fused weighted-combine kernel for
+        SCALAR/VECTOR weights over same-shape member logits (single-head).
+        The per-member weighted logits are then not materialized
+        (`WeightedSubnetwork.logits` is None); ensemble logits and
+        gradients are identical to the unfused path.
     """
 
     def __init__(
@@ -117,6 +122,7 @@ class ComplexityRegularizedEnsembler(Ensembler):
         adanet_beta: float = 0.0,
         use_bias: bool = False,
         name: Optional[str] = None,
+        use_fused_combine: bool = False,
     ):
         self._optimizer = optimizer
         self._mixture_weight_type = MixtureWeightType(mixture_weight_type)
@@ -126,6 +132,7 @@ class ComplexityRegularizedEnsembler(Ensembler):
         self._adanet_beta = float(adanet_beta)
         self._use_bias = use_bias
         self._name = name
+        self._use_fused_combine = use_fused_combine
 
     @property
     def name(self) -> str:
@@ -265,6 +272,36 @@ class ComplexityRegularizedEnsembler(Ensembler):
             last_layer, weight, precision=jax.lax.Precision.HIGHEST
         )
 
+    def _can_fuse(self, weights, subnetworks, keys) -> bool:
+        if not self._use_fused_combine or keys is not None:
+            return False
+        if self._mixture_weight_type == MixtureWeightType.MATRIX:
+            return False
+        shape = subnetworks[0].logits.shape
+        return all(s.logits.shape == shape for s in subnetworks)
+
+    def _build_fused(self, weights, subnetworks, bias):
+        """Pallas fused combine path (see `use_fused_combine`)."""
+        from adanet_tpu.ops.ensemble_kernels import fused_weighted_combine
+
+        stacked = jnp.stack(
+            [jnp.asarray(s.logits, jnp.float32) for s in subnetworks]
+        )
+        wstack = jnp.stack([jnp.asarray(w, jnp.float32) for w in weights])
+        logits = fused_weighted_combine(stacked, wstack, bias)
+        weighted_subnetworks = [
+            WeightedSubnetwork(subnetwork=s, weight=w, logits=None)
+            for w, s in zip(weights, subnetworks)
+        ]
+        return ComplexityRegularized(
+            weighted_subnetworks=weighted_subnetworks,
+            bias=bias,
+            logits=logits,
+            complexity_regularization=self._complexity_regularization(
+                weights, subnetworks
+            ),
+        )
+
     def build_ensemble(self, params, subnetworks, previous_ensemble=None):
         del previous_ensemble  # unused, matching reference build_ensemble
         weights = params["weights"]
@@ -274,6 +311,12 @@ class ComplexityRegularizedEnsembler(Ensembler):
                 % (len(weights), len(subnetworks))
             )
         keys = _sorted_keys(subnetworks[0].logits)
+        if self._can_fuse(weights, subnetworks, keys):
+            return self._build_fused(
+                weights,
+                subnetworks,
+                params.get("bias") if self._use_bias else None,
+            )
 
         weighted_subnetworks = []
         for weight, subnetwork in zip(weights, subnetworks):

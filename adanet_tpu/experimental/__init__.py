@@ -1,0 +1,64 @@
+"""Experimental "ModelFlow" API: Phases -> WorkUnits -> Scheduler.
+
+TPU-native analogue of the reference `adanet.experimental` package
+(reference: adanet/experimental/__init__.py): a second, greenfield pipeline
+API over plain trainable models, independent of the core AdaNet engine.
+"""
+
+from adanet_tpu.experimental.model import Model
+from adanet_tpu.experimental.phases import (
+    AllStrategy,
+    AutoEnsemblePhase,
+    Controller,
+    DatasetProvider,
+    EnsembleStrategy,
+    GrowStrategy,
+    InProcessScheduler,
+    InputPhase,
+    MeanEnsemble,
+    MeanEnsembler,
+    ModelProvider,
+    ModelSearch,
+    Phase,
+    RandomKStrategy,
+    RepeatPhase,
+    Scheduler,
+    SequentialController,
+    TrainerPhase,
+    TrainerWorkUnit,
+    TunerPhase,
+    WorkUnit,
+)
+from adanet_tpu.experimental.storages import (
+    InMemoryStorage,
+    ModelContainer,
+    Storage,
+)
+
+__all__ = [
+    "AllStrategy",
+    "AutoEnsemblePhase",
+    "Controller",
+    "DatasetProvider",
+    "EnsembleStrategy",
+    "GrowStrategy",
+    "InMemoryStorage",
+    "InProcessScheduler",
+    "InputPhase",
+    "MeanEnsemble",
+    "MeanEnsembler",
+    "Model",
+    "ModelContainer",
+    "ModelProvider",
+    "ModelSearch",
+    "Phase",
+    "RandomKStrategy",
+    "RepeatPhase",
+    "Scheduler",
+    "SequentialController",
+    "Storage",
+    "TrainerPhase",
+    "TrainerWorkUnit",
+    "TunerPhase",
+    "WorkUnit",
+]

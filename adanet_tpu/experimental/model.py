@@ -1,0 +1,130 @@
+"""A minimal trainable-model wrapper: the keras.Model role in ModelFlow.
+
+The reference's experimental stack passes `tf.keras.Model`s between phases
+(reference: adanet/experimental/keras/*). The JAX equivalent is this small
+`Model`: a Flax module + optax optimizer + loss/metric functions with
+compile/fit/evaluate semantics, jit-compiled steps, and frozen-model
+support (`trainable=False`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Model:
+    """A trainable (module, params) pair with fit/evaluate.
+
+    Args:
+      module: Flax module; `module.apply(vars, features, training=...)`
+        returns logits.
+      loss_fn: `fn(logits, labels) -> scalar`.
+      optimizer: optax transform (set by `compile` if not given).
+      metrics: dict name -> `fn(logits, labels) -> scalar`.
+      trainable: when False, `fit` is a no-op (frozen submodel).
+    """
+
+    def __init__(
+        self,
+        module,
+        loss_fn: Optional[Callable] = None,
+        optimizer=None,
+        metrics: Optional[Dict[str, Callable]] = None,
+        trainable: bool = True,
+        seed: int = 0,
+    ):
+        self.module = module
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.metrics = dict(metrics or {})
+        self.trainable = trainable
+        self.variables = None
+        self._opt_state = None
+        self._seed = seed
+
+    def compile(self, optimizer, loss_fn, metrics=None):
+        """Keras-style compile (reference work units call model.compile)."""
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        if metrics is not None:
+            self.metrics = dict(metrics)
+        return self
+
+    # ------------------------------------------------------------------ core
+
+    def _ensure_initialized(self, features):
+        if self.variables is None:
+            rng = jax.random.PRNGKey(self._seed)
+            self.variables = self.module.init(
+                {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+                features,
+                training=True,
+            )
+        if self._opt_state is None and self.optimizer is not None:
+            self._opt_state = self.optimizer.init(self.variables["params"])
+
+    def __call__(self, features, training: bool = False):
+        self._ensure_initialized(features)
+        return self.module.apply(self.variables, features, training=training)
+
+    def fit(self, dataset: Iterable, epochs: int = 1) -> "Model":
+        """Trains over the dataset; `dataset` yields (features, labels)."""
+        if not self.trainable:
+            return self
+        if self.loss_fn is None or self.optimizer is None:
+            raise ValueError("Model must be compiled before fit().")
+
+        @jax.jit
+        def step(variables, opt_state, features, labels):
+            def loss(p):
+                out = self.module.apply(
+                    {**variables, "params": p}, features, training=True
+                )
+                return self.loss_fn(out, labels)
+
+            value, grads = jax.value_and_grad(loss)(variables["params"])
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, variables["params"]
+            )
+            params = optax.apply_updates(variables["params"], updates)
+            return {**variables, "params": params}, opt_state, value
+
+        for _ in range(epochs):
+            for features, labels in dataset:
+                self._ensure_initialized(features)
+                self.variables, self._opt_state, _ = step(
+                    self.variables, self._opt_state, features, labels
+                )
+        return self
+
+    def evaluate(self, dataset: Iterable) -> List[float]:
+        """Returns [loss, metric...] means, keras-style."""
+        if self.loss_fn is None:
+            raise ValueError("Model must be compiled before evaluate().")
+
+        @jax.jit
+        def batch_metrics(variables, features, labels):
+            out = self.module.apply(variables, features, training=False)
+            values = [self.loss_fn(out, labels)]
+            for name in sorted(self.metrics):
+                values.append(self.metrics[name](out, labels))
+            return values
+
+        totals = None
+        count = 0
+        for features, labels in dataset:
+            self._ensure_initialized(features)
+            values = jax.device_get(
+                batch_metrics(self.variables, features, labels)
+            )
+            if totals is None:
+                totals = [0.0] * len(values)
+            totals = [t + float(v) for t, v in zip(totals, values)]
+            count += 1
+        if count == 0:
+            raise ValueError("evaluate() got an empty dataset.")
+        return [t / count for t in totals]

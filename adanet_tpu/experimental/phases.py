@@ -1,0 +1,432 @@
+"""Phases, work units, controllers, and schedulers for ModelFlow.
+
+Analogue of the reference experimental pipeline
+(reference: adanet/experimental/phases/*, work_units/*, controllers/*,
+schedulers/*): a linear workflow of Phases, each yielding WorkUnits that a
+Scheduler executes; phases chain by reading the previous phase's datasets
+and models.
+"""
+
+from __future__ import annotations
+
+import abc
+import random
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from adanet_tpu.experimental.model import Model
+from adanet_tpu.experimental.storages import (
+    InMemoryStorage,
+    ModelContainer,
+    Storage,
+)
+
+# ------------------------------------------------------------------ work units
+
+
+class WorkUnit(abc.ABC):
+    """A schedulable unit of work (reference: work_units/work_unit.py)."""
+
+    @abc.abstractmethod
+    def execute(self) -> None:
+        ...
+
+
+class TrainerWorkUnit(WorkUnit):
+    """fit -> evaluate -> store (reference: keras_trainer_work_unit.py:27-55)."""
+
+    def __init__(
+        self,
+        model: Model,
+        train_dataset: Callable[[], Iterable],
+        eval_dataset: Callable[[], Iterable],
+        storage: Storage,
+        epochs: int = 1,
+    ):
+        self._model = model
+        self._train_dataset = train_dataset
+        self._eval_dataset = eval_dataset
+        self._storage = storage
+        self._epochs = epochs
+
+    def execute(self) -> None:
+        if self._model.trainable:
+            self._model.fit(self._train_dataset(), epochs=self._epochs)
+        results = self._model.evaluate(self._eval_dataset())
+        self._storage.save_model(
+            ModelContainer(results[0], self._model, results)
+        )
+
+
+# --------------------------------------------------------------------- phases
+
+
+class Phase(abc.ABC):
+    """A stage in a linear workflow (reference: phases/phase.py:26-37)."""
+
+    def __init__(self, storage: Optional[Storage] = None):
+        self._storage = storage or InMemoryStorage()
+
+    @abc.abstractmethod
+    def work_units(
+        self, previous_phase: Optional["Phase"]
+    ) -> Iterator[WorkUnit]:
+        ...
+
+
+class DatasetProvider(Phase, abc.ABC):
+    """A phase that produces datasets (reference: phase.py:39-52)."""
+
+    @abc.abstractmethod
+    def get_train_dataset(self) -> Callable[[], Iterable]:
+        ...
+
+    @abc.abstractmethod
+    def get_eval_dataset(self) -> Callable[[], Iterable]:
+        ...
+
+
+class ModelProvider(Phase, abc.ABC):
+    """A phase that produces models (reference: phase.py:64-75)."""
+
+    @abc.abstractmethod
+    def get_models(self) -> Iterable[Model]:
+        ...
+
+    @abc.abstractmethod
+    def get_best_models(self, num_models: int = 1) -> Iterable[Model]:
+        ...
+
+
+class InputPhase(DatasetProvider):
+    """Supplies train/eval datasets (reference: phases/input_phase.py)."""
+
+    def __init__(self, train_dataset, eval_dataset):
+        super().__init__()
+        self._train = train_dataset
+        self._eval = eval_dataset
+
+    def get_train_dataset(self):
+        return self._train
+
+    def get_eval_dataset(self):
+        return self._eval
+
+    def work_units(self, previous_phase):
+        return iter(())
+
+
+def _datasets_from(previous_phase: Optional[Phase]):
+    if not isinstance(previous_phase, DatasetProvider):
+        raise ValueError(
+            "This phase must follow a DatasetProvider, got %r"
+            % (previous_phase,)
+        )
+    return (
+        previous_phase.get_train_dataset(),
+        previous_phase.get_eval_dataset(),
+    )
+
+
+class TrainerPhase(DatasetProvider, ModelProvider):
+    """Trains a fixed list of models
+    (reference: phases/keras_trainer_phase.py:28-71)."""
+
+    def __init__(
+        self,
+        models: Sequence[Model],
+        epochs: int = 1,
+        storage: Optional[Storage] = None,
+    ):
+        Phase.__init__(self, storage)
+        self._models = list(models)
+        self._epochs = epochs
+        self._train = None
+        self._eval = None
+
+    def work_units(self, previous_phase):
+        self._train, self._eval = _datasets_from(previous_phase)
+        for model in self._models:
+            yield TrainerWorkUnit(
+                model, self._train, self._eval, self._storage, self._epochs
+            )
+
+    def get_train_dataset(self):
+        return self._train
+
+    def get_eval_dataset(self):
+        return self._eval
+
+    def get_models(self):
+        return self._storage.get_models()
+
+    def get_best_models(self, num_models: int = 1):
+        return self._storage.get_best_models(num_models)
+
+
+class TunerPhase(TrainerPhase):
+    """Random-search over a model-builder function: the stand-in for the
+    reference's KerasTuner integration
+    (reference: phases/keras_tuner_phase.py:29-71).
+
+    `build_model(trial_rng) -> Model` is sampled `num_trials` times.
+    """
+
+    def __init__(
+        self,
+        build_model: Callable[[random.Random], Model],
+        num_trials: int = 4,
+        seed: int = 0,
+        epochs: int = 1,
+        storage: Optional[Storage] = None,
+    ):
+        rng = random.Random(seed)
+        models = [build_model(rng) for _ in range(num_trials)]
+        super().__init__(models, epochs=epochs, storage=storage)
+
+
+# ------------------------------------------------ ensemble phase + strategies
+
+
+class EnsembleStrategy(abc.ABC):
+    """Groups candidates into ensembles (reference: autoensemble_phase.py:33-41)."""
+
+    @abc.abstractmethod
+    def __call__(
+        self, candidates: List[Model]
+    ) -> Iterable[List[Model]]:
+        ...
+
+
+class GrowStrategy(EnsembleStrategy):
+    """One candidate at a time (reference: autoensemble_phase.py:84-91)."""
+
+    def __call__(self, candidates):
+        return [[candidate] for candidate in candidates]
+
+
+class AllStrategy(EnsembleStrategy):
+    """All candidates together (reference: autoensemble_phase.py:93-99)."""
+
+    def __call__(self, candidates):
+        return [list(candidates)]
+
+
+class RandomKStrategy(EnsembleStrategy):
+    """k random candidates with replacement
+    (reference: autoensemble_phase.py:101-107)."""
+
+    def __init__(self, k: int, seed: Optional[int] = None):
+        self._k = k
+        self._seed = seed
+
+    def __call__(self, candidates):
+        rng = random.Random(self._seed)
+        return [[rng.choice(candidates) for _ in range(self._k)]]
+
+
+class MeanEnsemble(Model):
+    """Frozen-submodel mean-of-outputs ensemble
+    (reference: keras/ensemble_model.py:26-60)."""
+
+    def __init__(self, submodels: Sequence[Model], loss_fn, metrics=None):
+        super().__init__(
+            module=None, loss_fn=loss_fn, metrics=metrics, trainable=False
+        )
+        self._submodels = list(submodels)
+
+    def _ensure_initialized(self, features):
+        return  # submodels own their variables
+
+    def __call__(self, features, training: bool = False):
+        outs = [m(features, training=False) for m in self._submodels]
+        return jnp.mean(jnp.stack(outs, axis=0), axis=0)
+
+    def evaluate(self, dataset):
+        totals = None
+        count = 0
+        for features, labels in dataset:
+            out = self(features)
+            values = [float(self.loss_fn(out, labels))]
+            for name in sorted(self.metrics):
+                values.append(float(self.metrics[name](out, labels)))
+            totals = (
+                values
+                if totals is None
+                else [t + v for t, v in zip(totals, values)]
+            )
+            count += 1
+        if count == 0:
+            raise ValueError("evaluate() got an empty dataset.")
+        return [t / count for t in totals]
+
+
+class MeanEnsembler:
+    """Combines submodels into a `MeanEnsemble`
+    (reference: autoensemble_phase.py:54-81)."""
+
+    def __init__(self, loss_fn, metrics=None):
+        self._loss_fn = loss_fn
+        self._metrics = metrics
+
+    def __call__(self, submodels: List[Model]) -> MeanEnsemble:
+        return MeanEnsemble(submodels, self._loss_fn, self._metrics)
+
+
+class AutoEnsemblePhase(DatasetProvider, ModelProvider):
+    """Ensembles the previous phase's best models
+    (reference: phases/autoensemble_phase.py:110-180)."""
+
+    def __init__(
+        self,
+        ensemblers: Sequence[Any],
+        ensemble_strategies: Sequence[EnsembleStrategy],
+        num_candidates: int = 3,
+        storage: Optional[Storage] = None,
+    ):
+        Phase.__init__(self, storage)
+        self._ensemblers = list(ensemblers)
+        self._strategies = list(ensemble_strategies)
+        self._num_candidates = num_candidates
+        self._train = None
+        self._eval = None
+
+    def work_units(self, previous_phase):
+        if not isinstance(previous_phase, ModelProvider):
+            raise ValueError("AutoEnsemblePhase must follow a ModelProvider.")
+        self._train, self._eval = _datasets_from(previous_phase)
+        candidates = list(
+            previous_phase.get_best_models(self._num_candidates)
+        )
+        for strategy in self._strategies:
+            for group in strategy(candidates):
+                for ensembler in self._ensemblers:
+                    yield TrainerWorkUnit(
+                        ensembler(group),
+                        self._train,
+                        self._eval,
+                        self._storage,
+                    )
+
+    def get_train_dataset(self):
+        return self._train
+
+    def get_eval_dataset(self):
+        return self._eval
+
+    def get_models(self):
+        return self._storage.get_models()
+
+    def get_best_models(self, num_models: int = 1):
+        return self._storage.get_best_models(num_models)
+
+
+class RepeatPhase(DatasetProvider, ModelProvider):
+    """Repeats a phase-factory pipeline n times
+    (reference: phases/repeat_phase.py)."""
+
+    def __init__(
+        self,
+        phase_factory: Sequence[Callable[[], Phase]],
+        repetitions: int,
+        storage: Optional[Storage] = None,
+    ):
+        Phase.__init__(self, storage)
+        self._phase_factory = list(phase_factory)
+        self._repetitions = repetitions
+        self._final_phase: Optional[Phase] = None
+
+    def work_units(self, previous_phase):
+        prev = previous_phase
+        for _ in range(self._repetitions):
+            for factory in self._phase_factory:
+                phase = factory()
+                for work_unit in phase.work_units(prev):
+                    yield work_unit
+                prev = phase
+        self._final_phase = prev
+
+    def get_train_dataset(self):
+        return self._final_phase.get_train_dataset()
+
+    def get_eval_dataset(self):
+        return self._final_phase.get_eval_dataset()
+
+    def get_models(self):
+        return self._final_phase.get_models()
+
+    def get_best_models(self, num_models: int = 1):
+        return self._final_phase.get_best_models(num_models)
+
+
+# --------------------------------------------------- controllers + schedulers
+
+
+class Controller(abc.ABC):
+    """Yields work units from phases (reference: controllers/controller.py)."""
+
+    @abc.abstractmethod
+    def work_units(self) -> Iterator[WorkUnit]:
+        ...
+
+    @abc.abstractmethod
+    def get_best_models(self, num_models: int = 1) -> Iterable[Model]:
+        ...
+
+
+class SequentialController(Controller):
+    """Executes phases in a user-defined order
+    (reference: controllers/sequential_controller.py:26-50)."""
+
+    def __init__(self, phases: Sequence[Phase]):
+        if not phases:
+            raise ValueError("phases must be non-empty.")
+        self._phases = list(phases)
+
+    def work_units(self) -> Iterator[WorkUnit]:
+        previous = None
+        for phase in self._phases:
+            for work_unit in phase.work_units(previous):
+                yield work_unit
+            previous = phase
+        self._final_phase = previous
+
+    def get_best_models(self, num_models: int = 1):
+        return self._final_phase.get_best_models(num_models)
+
+
+class Scheduler(abc.ABC):
+    """Executes work units (reference: schedulers/scheduler.py)."""
+
+    @abc.abstractmethod
+    def schedule(self, work_units: Iterator[WorkUnit]) -> None:
+        ...
+
+
+class InProcessScheduler(Scheduler):
+    """Runs work units sequentially in-process
+    (reference: schedulers/in_process_scheduler.py:27-38)."""
+
+    def schedule(self, work_units: Iterator[WorkUnit]) -> None:
+        for work_unit in work_units:
+            work_unit.execute()
+
+
+class ModelSearch:
+    """Top-level ModelFlow entry point
+    (reference: keras/model_search.py:29-50)."""
+
+    def __init__(
+        self,
+        controller: Controller,
+        scheduler: Optional[Scheduler] = None,
+    ):
+        self._controller = controller
+        self._scheduler = scheduler or InProcessScheduler()
+
+    def run(self) -> None:
+        self._scheduler.schedule(self._controller.work_units())
+
+    def get_best_models(self, num_models: int = 1) -> Iterable[Model]:
+        return self._controller.get_best_models(num_models)

@@ -1,0 +1,5 @@
+"""Model zoo: TPU-first Flax implementations of workload architectures."""
+
+from adanet_tpu.models.nasnet import NasNetA, NasNetConfig, calc_reduction_layers
+
+__all__ = ["NasNetA", "NasNetConfig", "calc_reduction_layers"]

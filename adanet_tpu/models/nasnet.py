@@ -1,0 +1,513 @@
+"""NASNet-A in Flax, TPU-first.
+
+From-scratch re-implementation of the NASNet-A search-space cells and the
+CIFAR/ImageNet network skeletons that the reference's improve_nas workload
+uses (reference: research/improve_nas/trainer/nasnet.py:300-555 and
+nasnet_utils.py:250-532 — themselves forked from slim). Behavior follows the
+published NASNet-A architecture: normal/reduction cells with the fixed
+operation lists, factorized reduction, drop-path with the v3 schedule
+(scaled by both layer depth and training progress), auxiliary head, and the
+CIFAR stem.
+
+TPU-first choices: NHWC layout, bfloat16 convolution compute with float32
+batch-norm statistics and logits, static shapes throughout (cell wiring is
+Python-level, traced once), and the drop-path progress tracked as a model
+variable so the whole network stays a single jittable function of
+(params, batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# NASNet-A cell specifications (reference: nasnet_utils.py:483-532).
+_NORMAL_OPERATIONS = (
+    "separable_5x5_2",
+    "separable_3x3_2",
+    "separable_5x5_2",
+    "separable_3x3_2",
+    "avg_pool_3x3",
+    "none",
+    "avg_pool_3x3",
+    "avg_pool_3x3",
+    "separable_3x3_2",
+    "none",
+)
+_NORMAL_HIDDENSTATE_INDICES = (0, 1, 1, 1, 0, 1, 1, 1, 0, 0)
+_NORMAL_USED_HIDDENSTATES = (1, 0, 0, 0, 0, 0, 0)
+
+_REDUCTION_OPERATIONS = (
+    "separable_5x5_2",
+    "separable_7x7_2",
+    "max_pool_3x3",
+    "separable_7x7_2",
+    "avg_pool_3x3",
+    "separable_5x5_2",
+    "none",
+    "avg_pool_3x3",
+    "separable_3x3_2",
+    "max_pool_3x3",
+)
+_REDUCTION_HIDDENSTATE_INDICES = (0, 1, 0, 1, 0, 1, 3, 2, 2, 0)
+_REDUCTION_USED_HIDDENSTATES = (1, 1, 1, 0, 0, 0, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class NasNetConfig:
+    """Hyperparameters (reference: nasnet.py cifar_config, 47-65)."""
+
+    num_classes: int = 10
+    num_cells: int = 18
+    num_conv_filters: int = 32
+    stem_multiplier: float = 3.0
+    filter_scaling_rate: float = 2.0
+    num_reduction_layers: int = 2
+    drop_path_keep_prob: float = 0.6
+    dense_dropout_keep_prob: float = 1.0
+    use_aux_head: bool = True
+    aux_head_weight: float = 0.4
+    total_training_steps: int = 937500
+    stem_type: str = "cifar"  # or "imagenet"
+    compute_dtype: Any = jnp.bfloat16
+
+
+def calc_reduction_layers(
+    num_cells: int, num_reduction_layers: int
+) -> List[int]:
+    """Which cell indices get reduction cells (reference: nasnet_utils.py:52-59)."""
+    return [
+        int(float(pool_num) / (num_reduction_layers + 1) * num_cells)
+        for pool_num in range(1, num_reduction_layers + 1)
+    ]
+
+
+def _batch_norm(x, training: bool, name: str):
+    # slim arg scope: decay 0.9997, epsilon 0.001 (NASNet paper defaults).
+    return nn.BatchNorm(
+        use_running_average=not training,
+        momentum=0.9997,
+        epsilon=1e-3,
+        dtype=jnp.float32,
+        name=name,
+    )(x)
+
+
+class _SepConv(nn.Module):
+    """Stacked relu -> depthwise+pointwise conv -> bn, repeated
+    (reference: nasnet_utils.py:183-211)."""
+
+    filters: int
+    kernel: int
+    stride: int
+    num_layers: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        stride = self.stride
+        for layer in range(self.num_layers):
+            x = nn.relu(x)
+            in_ch = x.shape[-1]
+            x = nn.Conv(
+                features=in_ch,
+                kernel_size=(self.kernel, self.kernel),
+                strides=(stride, stride),
+                feature_group_count=in_ch,
+                use_bias=False,
+                dtype=self.compute_dtype,
+                name="depthwise_%d" % layer,
+            )(x)
+            x = nn.Conv(
+                features=self.filters,
+                kernel_size=(1, 1),
+                use_bias=False,
+                dtype=self.compute_dtype,
+                name="pointwise_%d" % layer,
+            )(x)
+            x = _batch_norm(x, training, "bn_%d" % layer)
+            stride = 1
+        return x
+
+
+class _FactorizedReduction(nn.Module):
+    """Stride-2 reduction without information loss
+    (reference: nasnet_utils.py:92-134)."""
+
+    filters: int
+    stride: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        if self.stride == 1:
+            x = nn.Conv(
+                self.filters,
+                (1, 1),
+                use_bias=False,
+                dtype=self.compute_dtype,
+                name="path_conv",
+            )(x)
+            return _batch_norm(x, training, "path_bn")
+        # Path 1: stride-2 avg pool (1x1 window) + 1x1 conv.
+        path1 = nn.avg_pool(x, (1, 1), strides=(self.stride, self.stride))
+        path1 = nn.Conv(
+            self.filters // 2,
+            (1, 1),
+            use_bias=False,
+            dtype=self.compute_dtype,
+            name="path1_conv",
+        )(path1)
+        # Path 2: shift by one pixel, then the same.
+        path2 = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))[:, 1:, 1:, :]
+        path2 = nn.avg_pool(
+            path2, (1, 1), strides=(self.stride, self.stride)
+        )
+        path2 = nn.Conv(
+            self.filters // 2 + self.filters % 2,
+            (1, 1),
+            use_bias=False,
+            dtype=self.compute_dtype,
+            name="path2_conv",
+        )(path2)
+        out = jnp.concatenate([path1, path2], axis=-1)
+        return _batch_norm(out, training, "final_path_bn")
+
+
+def _drop_path(x, keep_prob, rng):
+    """Drops a whole example's residual branch
+    (reference: nasnet_utils.py:137-148)."""
+    batch = x.shape[0]
+    mask = jnp.floor(
+        keep_prob + jax.random.uniform(rng, (batch, 1, 1, 1), jnp.float32)
+    )
+    return x * jnp.asarray(1.0 / keep_prob, x.dtype) * jnp.asarray(
+        mask, x.dtype
+    )
+
+
+class _NasNetCell(nn.Module):
+    """One NASNet-A cell (reference: nasnet_utils.py:250-480)."""
+
+    operations: Sequence[str]
+    hiddenstate_indices: Sequence[int]
+    used_hiddenstates: Sequence[int]
+    filters: int
+    stride: int
+    cell_num: int
+    total_num_cells: int
+    drop_path_keep_prob: float
+    compute_dtype: Any
+
+    def _apply_operation(
+        self, x, operation, stride, is_original_input, training, progress, name
+    ):
+        input_filters = x.shape[-1]
+        if stride > 1 and not is_original_input:
+            stride = 1
+        if "separable" in operation:
+            parts = operation.split("_")
+            kernel = int(parts[1].split("x")[0])
+            num_layers = int(parts[2])
+            x = _SepConv(
+                filters=self.filters,
+                kernel=kernel,
+                stride=stride,
+                num_layers=num_layers,
+                compute_dtype=self.compute_dtype,
+                name="%s_sep" % name,
+            )(x, training)
+        elif operation == "none":
+            if stride > 1 or input_filters != self.filters:
+                x = nn.relu(x)
+                x = nn.Conv(
+                    self.filters,
+                    (1, 1),
+                    strides=(stride, stride),
+                    use_bias=False,
+                    dtype=self.compute_dtype,
+                    name="%s_1x1" % name,
+                )(x)
+                x = _batch_norm(x, training, "%s_bn1" % name)
+        elif "pool" in operation:
+            pool_type = operation.split("_")[0]
+            window = int(operation.split("_")[-1].split("x")[0])
+            pool = nn.max_pool if pool_type == "max" else nn.avg_pool
+            x = pool(
+                x,
+                (window, window),
+                strides=(stride, stride),
+                padding="SAME",
+            )
+            if input_filters != self.filters:
+                x = nn.Conv(
+                    self.filters,
+                    (1, 1),
+                    use_bias=False,
+                    dtype=self.compute_dtype,
+                    name="%s_1x1" % name,
+                )(x)
+                x = _batch_norm(x, training, "%s_bn1" % name)
+        else:
+            raise ValueError("Unimplemented operation %r" % operation)
+
+        if operation != "none" and training and self.drop_path_keep_prob < 1.0:
+            # v3 schedule: scale keep prob by layer depth AND training
+            # progress (reference: nasnet_utils.py:436-480).
+            layer_ratio = (self.cell_num + 1) / float(self.total_num_cells)
+            keep_prob = 1.0 - layer_ratio * (
+                1.0 - self.drop_path_keep_prob
+            )
+            keep_prob = 1.0 - progress * (1.0 - keep_prob)
+            x = _drop_path(x, keep_prob, self.make_rng("dropout"))
+        return x
+
+    def _reduce_prev_layer(self, prev_layer, curr_layer, training):
+        """Matches prev layer dims to curr (reference: nasnet_utils.py:283-301)."""
+        if prev_layer is None:
+            return curr_layer
+        if prev_layer.shape[2] != curr_layer.shape[2]:
+            prev_layer = nn.relu(prev_layer)
+            prev_layer = _FactorizedReduction(
+                filters=self.filters,
+                stride=2,
+                compute_dtype=self.compute_dtype,
+                name="reduce_prev",
+            )(prev_layer, training)
+        elif prev_layer.shape[-1] != self.filters:
+            prev_layer = nn.relu(prev_layer)
+            prev_layer = nn.Conv(
+                self.filters,
+                (1, 1),
+                use_bias=False,
+                dtype=self.compute_dtype,
+                name="prev_1x1",
+            )(prev_layer)
+            prev_layer = _batch_norm(prev_layer, training, "prev_bn")
+        return prev_layer
+
+    @nn.compact
+    def __call__(self, net, prev_layer, training: bool, progress):
+        prev_layer = self._reduce_prev_layer(prev_layer, net, training)
+        x = nn.relu(net)
+        x = nn.Conv(
+            self.filters,
+            (1, 1),
+            use_bias=False,
+            dtype=self.compute_dtype,
+            name="beginning_1x1",
+        )(x)
+        x = _batch_norm(x, training, "beginning_bn")
+
+        states = [x, prev_layer]
+        for block in range(5):
+            left_idx = self.hiddenstate_indices[2 * block]
+            right_idx = self.hiddenstate_indices[2 * block + 1]
+            h1 = self._apply_operation(
+                states[left_idx],
+                self.operations[2 * block],
+                self.stride,
+                left_idx < 2,
+                training,
+                progress,
+                "block%d_left" % block,
+            )
+            h2 = self._apply_operation(
+                states[right_idx],
+                self.operations[2 * block + 1],
+                self.stride,
+                right_idx < 2,
+                training,
+                progress,
+                "block%d_right" % block,
+            )
+            states.append(h1 + h2)
+
+        # Concat unused states, factorized-reducing shape mismatches
+        # (reference: nasnet_utils.py:404-431).
+        final = states[-1]
+        to_combine = []
+        for idx, used in enumerate(self.used_hiddenstates):
+            state = states[idx]
+            if used:
+                continue
+            mismatch = (
+                state.shape[2] != final.shape[2]
+                or state.shape[-1] != final.shape[-1]
+            )
+            if mismatch:
+                stride = 2 if state.shape[2] != final.shape[2] else 1
+                state = _FactorizedReduction(
+                    filters=final.shape[-1],
+                    stride=stride,
+                    compute_dtype=self.compute_dtype,
+                    name="reduction_%d" % idx,
+                )(state, training)
+            to_combine.append(state)
+        return jnp.concatenate(to_combine, axis=-1)
+
+
+class _AuxHead(nn.Module):
+    """Auxiliary classifier (reference: nasnet.py:235-258)."""
+
+    num_classes: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = nn.Conv(
+            128, (1, 1), use_bias=False, dtype=self.compute_dtype, name="proj"
+        )(x)
+        x = _batch_norm(x, training, "aux_bn0")
+        x = nn.relu(x)
+        x = nn.Conv(
+            768,
+            (x.shape[1], x.shape[2]),
+            padding="VALID",
+            use_bias=False,
+            dtype=self.compute_dtype,
+            name="full",
+        )(x)
+        x = _batch_norm(x, training, "aux_bn1")
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="aux_logits"
+        )(jnp.asarray(x, jnp.float32))
+
+
+class NasNetA(nn.Module):
+    """The full NASNet-A network (reference: nasnet.py:460-555).
+
+    `__call__(images, training)` returns `(logits, aux_logits, pooled)`;
+    `aux_logits` is None outside training or when disabled.
+    """
+
+    config: NasNetConfig
+
+    @nn.compact
+    def __call__(self, images, training: bool = False):
+        cfg = self.config
+        x = jnp.asarray(images, cfg.compute_dtype)
+
+        # Drop-path progress = step / total_training_steps, tracked as a
+        # model variable so the network stays a pure function of
+        # (variables, batch) — the analogue of the reference reading the
+        # global step (nasnet_utils.py:455-466).
+        step = self.variable(
+            "schedule", "step", lambda: jnp.zeros((), jnp.float32)
+        )
+        progress = jnp.minimum(
+            step.value / float(cfg.total_training_steps), 1.0
+        )
+        if training and not self.is_initializing():
+            step.value = step.value + 1.0
+
+        reduction_indices = calc_reduction_layers(
+            cfg.num_cells, cfg.num_reduction_layers
+        )
+        total_num_cells = cfg.num_cells + cfg.num_reduction_layers
+
+        def make_cell(kind, filters, stride, cell_num, name):
+            spec = {
+                "normal": (
+                    _NORMAL_OPERATIONS,
+                    _NORMAL_HIDDENSTATE_INDICES,
+                    _NORMAL_USED_HIDDENSTATES,
+                ),
+                "reduction": (
+                    _REDUCTION_OPERATIONS,
+                    _REDUCTION_HIDDENSTATE_INDICES,
+                    _REDUCTION_USED_HIDDENSTATES,
+                ),
+            }[kind]
+            return _NasNetCell(
+                operations=spec[0],
+                hiddenstate_indices=spec[1],
+                used_hiddenstates=spec[2],
+                filters=filters,
+                stride=stride,
+                cell_num=cell_num,
+                total_num_cells=total_num_cells,
+                drop_path_keep_prob=cfg.drop_path_keep_prob,
+                compute_dtype=cfg.compute_dtype,
+                name=name,
+            )
+
+        # CIFAR stem: plain 3x3 conv + bn (reference: nasnet.py:288-297).
+        stem_filters = int(cfg.num_conv_filters * cfg.stem_multiplier)
+        net = nn.Conv(
+            stem_filters,
+            (3, 3),
+            use_bias=False,
+            dtype=cfg.compute_dtype,
+            name="stem_conv",
+        )(x)
+        net = _batch_norm(net, training, "stem_bn")
+        cell_outputs: List[Optional[jnp.ndarray]] = [None, net]
+
+        aux_logits = None
+        aux_cell_index = (
+            reduction_indices[1] - 1 if len(reduction_indices) >= 2 else -1
+        )
+        filter_scaling = 1.0
+        true_cell_num = 0
+        for cell_num in range(cfg.num_cells):
+            if cell_num in reduction_indices:
+                filter_scaling *= cfg.filter_scaling_rate
+                net = make_cell(
+                    "reduction",
+                    int(cfg.num_conv_filters * filter_scaling),
+                    2,
+                    true_cell_num,
+                    "reduction_cell_%d"
+                    % reduction_indices.index(cell_num),
+                )(net, cell_outputs[-2], training, progress)
+                true_cell_num += 1
+                cell_outputs.append(net)
+            prev_layer = cell_outputs[-2]
+            net = make_cell(
+                "normal",
+                int(cfg.num_conv_filters * filter_scaling),
+                1,
+                true_cell_num,
+                "cell_%d" % cell_num,
+            )(net, prev_layer, training, progress)
+            true_cell_num += 1
+            if (
+                cfg.use_aux_head
+                and cell_num == aux_cell_index
+                and cfg.num_classes
+                and training
+                # The aux head needs room for its 5x5/stride-3 pool; on
+                # tiny inputs (tests) it is skipped rather than producing
+                # a zero-sized feature map.
+                and net.shape[1] >= 5
+                and net.shape[2] >= 5
+            ):
+                aux_logits = _AuxHead(
+                    num_classes=cfg.num_classes,
+                    compute_dtype=cfg.compute_dtype,
+                    name="aux_head",
+                )(net, training)
+            cell_outputs.append(net)
+
+        # Final classifier (reference: nasnet.py:541-555).
+        net = nn.relu(net)
+        pooled = jnp.asarray(jnp.mean(net, axis=(1, 2)), jnp.float32)
+        out = pooled
+        if cfg.dense_dropout_keep_prob < 1.0:
+            out = nn.Dropout(
+                rate=1.0 - cfg.dense_dropout_keep_prob,
+                deterministic=not training,
+            )(out)
+        logits = nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, name="logits"
+        )(out)
+        return logits, aux_logits, pooled

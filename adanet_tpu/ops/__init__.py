@@ -1,0 +1,5 @@
+"""Custom Pallas TPU ops for the hot paths."""
+
+from adanet_tpu.ops.ensemble_kernels import fused_weighted_combine
+
+__all__ = ["fused_weighted_combine"]

@@ -37,13 +37,18 @@ class Subnetwork:
         regularization term `(lambda * r(h) + beta) * |w|_1`.
       shared: arbitrary auxiliary pytree shared with future iterations (the
         reference passes python/tensor state across iterations the same way,
-        e.g. `num_layers` in examples/simple_dnn.py:206-209).
+        e.g. `num_layers` in examples/simple_dnn.py:206-209). Persisted with
+        the frozen winner, so keep it small and static-valued.
+      extras: per-forward auxiliary outputs (e.g. NASNet auxiliary-head
+        logits) available to `Builder.build_subnetwork_loss` within the
+        training step; NOT persisted across iterations.
     """
 
     last_layer: Any
     logits: Any
     complexity: Any = 0.0
     shared: Any = None
+    extras: Any = None
 
 
 class Builder(abc.ABC):
@@ -98,6 +103,29 @@ class Builder(abc.ABC):
         Analogue of reference generator.py:255-270; default None means no
         report for this subnetwork.
         """
+        return None
+
+    def build_subnetwork_loss(self, subnetwork, labels, head, context):
+        """Optional custom training loss for this subnetwork (inside jit).
+
+        The analogue of reference builders that define their own training
+        loss rather than the head's (e.g. label smoothing + knowledge
+        distillation + auxiliary-head loss in
+        reference research/improve_nas/trainer/improve_nas.py:146-188).
+
+        Args:
+          subnetwork: this subnetwork's `Subnetwork` output (with `extras`).
+          labels: the batch labels.
+          head: the task `Head` (for its loss primitive).
+          context: a `TrainLossContext` with teacher signals:
+            `previous_ensemble_logits` (the frozen ensemble's logits on this
+            batch; ADAPTIVE distillation) and `previous_subnetwork_logits`
+            (the most recent frozen member's logits; BORN_AGAIN).
+
+        Returns:
+          A scalar loss, or None to use `head.loss(logits, labels)`.
+        """
+        del subnetwork, labels, head, context
         return None
 
 

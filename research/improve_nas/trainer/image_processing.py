@@ -1,0 +1,52 @@
+"""CIFAR image augmentation: pad-and-crop, horizontal flip, cutout.
+
+Analogue of reference image_processing
+(reference: research/improve_nas/trainer/image_processing.py:37-90), in
+numpy on the host input pipeline (augmentation is IO-side work; the TPU
+sees only the augmented batches).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def augment_batch(
+    images: np.ndarray,
+    rng: np.random.RandomState,
+    pad: int = 4,
+    cutout_size: int = 16,
+    use_cutout: bool = True,
+) -> np.ndarray:
+    """Random crop (after padding), random flip, and cutout per image."""
+    n, h, w, c = images.shape
+    padded = np.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+    )
+    out = np.empty_like(images)
+    for i in range(n):
+        top = rng.randint(0, 2 * pad + 1)
+        left = rng.randint(0, 2 * pad + 1)
+        img = padded[i, top : top + h, left : left + w, :]
+        if rng.rand() < 0.5:
+            img = img[:, ::-1, :]
+        out[i] = img
+    if use_cutout and cutout_size > 0:
+        out = cutout_batch(out, rng, cutout_size)
+    return out
+
+
+def cutout_batch(
+    images: np.ndarray, rng: np.random.RandomState, size: int
+) -> np.ndarray:
+    """Zeroes a random size x size square per image (DeVries & Taylor '17,
+    as used by reference image_processing.py:62-90)."""
+    n, h, w, _ = images.shape
+    out = images.copy()
+    for i in range(n):
+        cy = rng.randint(h)
+        cx = rng.randint(w)
+        y0, y1 = max(0, cy - size // 2), min(h, cy + size // 2)
+        x0, x1 = max(0, cx - size // 2), min(w, cx + size // 2)
+        out[i, y0:y1, x0:x1, :] = 0.0
+    return out

@@ -1,0 +1,313 @@
+"""improve_nas: NASNet subnetworks for AdaNet, with knowledge distillation.
+
+TPU-native re-design of the reference improve_nas workload
+(reference: research/improve_nas/trainer/improve_nas.py:60-338,
+arXiv:1903.06236): AdaNet over NASNet-A candidates with adaptive or
+born-again knowledge distillation, auxiliary-head loss, label smoothing, and
+weight decay, plus a `DynamicGenerator` that grows the search space
+(+3 cells deeper, +10 filters wider) each iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+import adanet_tpu
+from adanet_tpu.models.nasnet import NasNetA, NasNetConfig
+from adanet_tpu.subnetwork import Builder as BuilderBase
+from adanet_tpu.subnetwork import Generator as GeneratorBase
+from adanet_tpu.subnetwork import Subnetwork
+
+_PREVIOUS_NUM_CELLS = "num_cells"
+_PREVIOUS_CONV_FILTERS = "num_conv_filters"
+
+
+class KnowledgeDistillation(str, enum.Enum):
+    """Distillation modes (reference: improve_nas.py:44-57)."""
+
+    NONE = "none"
+    ADAPTIVE = "adaptive"  # teacher = previous ensemble logits
+    BORN_AGAIN = "born_again"  # teacher = last frozen subnetwork logits
+
+
+@dataclasses.dataclass(frozen=True)
+class Hparams:
+    """Workload hyperparameters (reference: adanet_improve_nas.py hparams +
+    nasnet cifar_config)."""
+
+    num_cells: int = 18
+    num_conv_filters: int = 32
+    aux_head_weight: float = 0.4
+    label_smoothing: float = 0.1
+    weight_decay: float = 5e-4
+    clip_gradients: float = 5.0
+    knowledge_distillation: KnowledgeDistillation = KnowledgeDistillation.NONE
+    initial_learning_rate: float = 0.025
+    drop_path_keep_prob: float = 0.6
+    dense_dropout_keep_prob: float = 1.0
+    use_aux_head: bool = True
+    total_training_steps: int = 937500
+    stem_multiplier: float = 3.0
+    compute_dtype: Any = jnp.bfloat16
+
+    def replace(self, **kwargs) -> "Hparams":
+        return dataclasses.replace(self, **kwargs)
+
+
+class _NasNetSubnetworkModule(nn.Module):
+    """Wraps `NasNetA` into the `Subnetwork` contract."""
+
+    config: NasNetConfig
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        images = (
+            features["image"] if isinstance(features, dict) else features
+        )
+        logits, aux_logits, pooled = NasNetA(self.config, name="nasnet")(
+            images, training=training
+        )
+        return Subnetwork(
+            last_layer=pooled,
+            logits=logits,
+            # Complexity hardcoded to 1, matching reference
+            # improve_nas.py:141.
+            complexity=1.0,
+            shared={
+                _PREVIOUS_NUM_CELLS: self.config.num_cells,
+                _PREVIOUS_CONV_FILTERS: self.config.num_conv_filters,
+            },
+            extras={"aux_logits": aux_logits},
+        )
+
+
+def _smoothed_softmax_cross_entropy(logits, labels, label_smoothing):
+    """Mean softmax CE against (optionally smoothed) one-hot labels."""
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(jnp.reshape(labels, (-1,)), num_classes)
+    if label_smoothing > 0:
+        onehot = (
+            onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+        )
+    return jnp.mean(
+        optax.softmax_cross_entropy(jnp.asarray(logits, jnp.float32), onehot)
+    )
+
+
+def _distillation_loss(student_logits, teacher_logits):
+    """CE of the student against the teacher's soft labels
+    (reference: improve_nas.py:166-180)."""
+    soft = jax.nn.softmax(jnp.asarray(teacher_logits, jnp.float32))
+    return jnp.mean(
+        optax.softmax_cross_entropy(
+            jnp.asarray(student_logits, jnp.float32), soft
+        )
+    )
+
+
+class Builder(BuilderBase):
+    """Builds a NASNet-A subnetwork (reference: improve_nas.py:60-214)."""
+
+    def __init__(
+        self,
+        optimizer_fn,
+        hparams: Hparams,
+        seed: Optional[int] = None,
+        num_classes: int = 10,
+    ):
+        self._optimizer_fn = optimizer_fn
+        self._hparams = hparams
+        self._seed = seed
+        self._num_classes = num_classes
+
+    @property
+    def name(self) -> str:
+        return "NasNet_A_{}_{}".format(
+            self._hparams.num_cells, self._hparams.num_conv_filters
+        )
+
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        hp = self._hparams
+        config = NasNetConfig(
+            num_classes=(
+                logits_dimension
+                if isinstance(logits_dimension, int)
+                else self._num_classes
+            ),
+            num_cells=hp.num_cells,
+            num_conv_filters=hp.num_conv_filters,
+            stem_multiplier=hp.stem_multiplier,
+            drop_path_keep_prob=hp.drop_path_keep_prob,
+            dense_dropout_keep_prob=hp.dense_dropout_keep_prob,
+            use_aux_head=hp.use_aux_head,
+            aux_head_weight=hp.aux_head_weight,
+            total_training_steps=hp.total_training_steps,
+            compute_dtype=hp.compute_dtype,
+        )
+        return _NasNetSubnetworkModule(config)
+
+    def build_train_optimizer(self, previous_ensemble=None):
+        hp = self._hparams
+        transforms = []
+        if hp.clip_gradients > 0:
+            transforms.append(optax.clip_by_global_norm(hp.clip_gradients))
+        if hp.weight_decay > 0:
+            # slim applies the L2 penalty to conv/dense kernels only; mask
+            # out batch-norm scales/biases accordingly.
+            def kernels_only(params):
+                return jax.tree_util.tree_map_with_path(
+                    lambda path, _: any(
+                        getattr(k, "key", None) == "kernel" for k in path
+                    ),
+                    params,
+                )
+
+            transforms.append(
+                optax.add_decayed_weights(hp.weight_decay, mask=kernels_only)
+            )
+        transforms.append(self._optimizer_fn(hp.initial_learning_rate))
+        return optax.chain(*transforms)
+
+    def build_subnetwork_loss(self, subnetwork, labels, head, context):
+        """Label smoothing + aux head + knowledge distillation
+        (reference: improve_nas.py:146-188)."""
+        hp = self._hparams
+        loss = _smoothed_softmax_cross_entropy(
+            subnetwork.logits, labels, hp.label_smoothing
+        )
+        extras = subnetwork.extras or {}
+        aux_logits = extras.get("aux_logits")
+        if aux_logits is not None and hp.use_aux_head:
+            loss = loss + hp.aux_head_weight * _smoothed_softmax_cross_entropy(
+                aux_logits, labels, hp.label_smoothing
+            )
+        if context is not None:
+            kd = KnowledgeDistillation(hp.knowledge_distillation)
+            if (
+                kd == KnowledgeDistillation.ADAPTIVE
+                and context.previous_ensemble_logits is not None
+            ):
+                loss = loss + _distillation_loss(
+                    subnetwork.logits, context.previous_ensemble_logits
+                )
+            elif (
+                kd == KnowledgeDistillation.BORN_AGAIN
+                and context.previous_subnetwork_logits is not None
+            ):
+                loss = loss + _distillation_loss(
+                    subnetwork.logits, context.previous_subnetwork_logits
+                )
+        return loss
+
+    def build_subnetwork_report(self):
+        return adanet_tpu.subnetwork.Report(
+            hparams={
+                "num_cells": self._hparams.num_cells,
+                "num_conv_filters": self._hparams.num_conv_filters,
+                "learning_rate": self._hparams.initial_learning_rate,
+            },
+            attributes={
+                "knowledge_distillation": str(
+                    KnowledgeDistillation(
+                        self._hparams.knowledge_distillation
+                    ).value
+                )
+            },
+            metrics={},
+        )
+
+
+def _previous_architecture(previous_ensemble, hparams: Hparams):
+    """Reads the last frozen member's architecture from `shared`
+    (reference: improve_nas.py:316-325)."""
+    num_cells = hparams.num_cells
+    num_conv_filters = hparams.num_conv_filters
+    if previous_ensemble:
+        shared = (
+            previous_ensemble.weighted_subnetworks[-1].subnetwork.shared
+            or {}
+        )
+        num_cells = int(shared.get(_PREVIOUS_NUM_CELLS, num_cells))
+        num_conv_filters = int(
+            shared.get(_PREVIOUS_CONV_FILTERS, num_conv_filters)
+        )
+    return num_cells, num_conv_filters
+
+
+class Generator(GeneratorBase):
+    """Fixed-architecture generator (reference: improve_nas.py:217-263)."""
+
+    def __init__(
+        self, optimizer_fn, hparams: Hparams, seed=None, num_classes=10
+    ):
+        if hparams.num_cells % 3 != 0:
+            raise ValueError("num_cells must be a multiple of 3.")
+        self._optimizer_fn = optimizer_fn
+        self._hparams = hparams
+        self._seed = seed
+        self._num_classes = num_classes
+
+    def generate_candidates(
+        self,
+        previous_ensemble,
+        iteration_number,
+        previous_ensemble_reports,
+        all_reports,
+        config=None,
+    ) -> List[Builder]:
+        return [
+            Builder(
+                self._optimizer_fn,
+                self._hparams,
+                seed=self._seed,
+                num_classes=self._num_classes,
+            )
+        ]
+
+
+class DynamicGenerator(GeneratorBase):
+    """Grows the search space each iteration: one deeper (+3 cells) and one
+    wider (+10 filters) candidate (reference: improve_nas.py:266-338)."""
+
+    def __init__(
+        self, optimizer_fn, hparams: Hparams, seed=None, num_classes=10
+    ):
+        if hparams.num_cells % 3 != 0:
+            raise ValueError("num_cells must be a multiple of 3.")
+        self._optimizer_fn = optimizer_fn
+        self._hparams = hparams
+        self._seed = seed
+        self._num_classes = num_classes
+
+    def generate_candidates(
+        self,
+        previous_ensemble,
+        iteration_number,
+        previous_ensemble_reports,
+        all_reports,
+        config=None,
+    ) -> List[Builder]:
+        num_cells, num_conv_filters = _previous_architecture(
+            previous_ensemble, self._hparams
+        )
+        make = lambda **kw: Builder(
+            self._optimizer_fn,
+            self._hparams.replace(**kw),
+            seed=self._seed,
+            num_classes=self._num_classes,
+        )
+        return [
+            make(
+                num_cells=num_cells + 3, num_conv_filters=num_conv_filters
+            ),
+            make(
+                num_cells=num_cells, num_conv_filters=num_conv_filters + 10
+            ),
+        ]

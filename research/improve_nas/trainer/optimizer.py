@@ -1,0 +1,59 @@
+"""Optimizers and learning-rate schedules keyed by name.
+
+TPU-native analogue of the reference optimizer module
+(reference: research/improve_nas/trainer/optimizer.py:28-131), built on
+optax: string-keyed optimizers (adagrad/adam/momentum/rmsprop/sgd) combined
+with constant or single-period cosine learning-rate schedules.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import optax
+
+_OPTIMIZERS = {
+    "adagrad": optax.adagrad,
+    "adam": optax.adam,
+    "momentum": functools.partial(optax.sgd, momentum=0.9),
+    "rmsprop": optax.rmsprop,
+    "sgd": optax.sgd,
+}
+
+
+def fn_with_name(
+    optimizer_name: str,
+    learning_rate_schedule: str = "constant",
+    cosine_decay_steps: Optional[int] = None,
+) -> Callable[[float], optax.GradientTransformation]:
+    """Returns `optimizer_fn(learning_rate) -> GradientTransformation`.
+
+    Mirrors reference optimizer.fn_with_name (optimizer.py:83-131): the
+    cosine schedule decays over `cosine_decay_steps` to alpha=0.
+    """
+    optimizer_name = optimizer_name.lower()
+    if optimizer_name not in _OPTIMIZERS:
+        raise ValueError("Invalid optimizer '{}'".format(optimizer_name))
+    schedule_name = learning_rate_schedule.lower()
+    if schedule_name not in ("constant", "cosine"):
+        raise ValueError(
+            "Invalid learning_rate_schedule '{}'".format(
+                learning_rate_schedule
+            )
+        )
+    if schedule_name == "cosine" and not cosine_decay_steps:
+        raise ValueError("cosine schedule requires cosine_decay_steps.")
+
+    def optimizer_fn(learning_rate: float) -> optax.GradientTransformation:
+        if schedule_name == "cosine":
+            schedule = optax.cosine_decay_schedule(
+                init_value=learning_rate,
+                decay_steps=cosine_decay_steps,
+                alpha=0.0,
+            )
+        else:
+            schedule = learning_rate
+        return _OPTIMIZERS[optimizer_name](schedule)
+
+    return optimizer_fn

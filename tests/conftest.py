@@ -9,7 +9,34 @@ imports this file, so env vars alone are too late — the jax config values
 must be updated directly (backends are still uninitialized at this point).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+# Persistent XLA compilation cache: NASNet-class modules are expensive to
+# compile on CPU; repeated test runs reuse compiled executables.
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy workload tests; run with RUN_SLOW=1"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if os.environ.get("RUN_SLOW"):
+        return
+    skip = pytest.mark.skip(reason="slow workload test; set RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -117,6 +117,43 @@ def test_round_robin_executor_trains():
     assert len(frozen.weighted_subnetworks) == 1
 
 
+def test_worker_wait_for_iteration(tmp_path):
+    """The checkpoint handshake: a worker unblocks when the manifest
+    advances, and times out cleanly otherwise."""
+    import threading
+
+    from adanet_tpu.core import checkpoint as ckpt_lib
+    from adanet_tpu.distributed import WorkerWaitTimeout, wait_for_iteration
+
+    model_dir = str(tmp_path)
+    ckpt_lib.write_manifest(
+        model_dir, ckpt_lib.CheckpointInfo(iteration_number=0)
+    )
+
+    def chief():
+        import time
+
+        time.sleep(0.3)
+        ckpt_lib.write_manifest(
+            model_dir,
+            ckpt_lib.CheckpointInfo(iteration_number=1, global_step=8),
+        )
+
+    thread = threading.Thread(target=chief)
+    thread.start()
+    info = wait_for_iteration(
+        model_dir, 1, timeout_secs=10.0, poll_interval_secs=0.05
+    )
+    thread.join()
+    assert info.iteration_number == 1
+    assert info.global_step == 8
+
+    with pytest.raises(WorkerWaitTimeout):
+        wait_for_iteration(
+            model_dir, 2, timeout_secs=0.2, poll_interval_secs=0.05
+        )
+
+
 def test_round_robin_executor_stale_sync():
     """sync_every > 1 (async-PS analogue) still trains and selects."""
     factory = IterationBuilder(

@@ -148,6 +148,58 @@ class TestComplexityRegularized:
         assert float(out.complexity_regularization) > 0.0
 
 
+class TestFusedCombine:
+    @pytest.mark.parametrize("mixture_type", ["scalar", "vector"])
+    def test_fused_matches_unfused(self, mixture_type):
+        from adanet_tpu.ensemble.weighted import MixtureWeightType
+
+        members = _members(3)
+        plain = ComplexityRegularizedEnsembler(
+            mixture_weight_type=MixtureWeightType(mixture_type),
+            adanet_lambda=0.1,
+            use_bias=True,
+        )
+        fused = ComplexityRegularizedEnsembler(
+            mixture_weight_type=MixtureWeightType(mixture_type),
+            adanet_lambda=0.1,
+            use_bias=True,
+            use_fused_combine=True,
+        )
+        params = plain.init_ensemble(jax.random.PRNGKey(0), members)
+        out_plain = plain.build_ensemble(params, members)
+        out_fused = fused.build_ensemble(params, members)
+        np.testing.assert_allclose(
+            out_fused.logits, out_plain.logits, rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            out_fused.complexity_regularization,
+            out_plain.complexity_regularization,
+            rtol=1e-5,
+        )
+        assert out_fused.weighted_subnetworks[0].logits is None
+
+        def loss(p, ens):
+            return jnp.sum(ens.build_ensemble(p, members).logits ** 2)
+
+        g_plain = jax.grad(loss)(params, plain)
+        g_fused = jax.grad(loss)(params, fused)
+        for a, b in zip(g_plain["weights"], g_fused["weights"]):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_fused_falls_back_for_matrix_and_multihead(self):
+        from adanet_tpu.ensemble.weighted import MixtureWeightType
+
+        members = _members(2)
+        ens = ComplexityRegularizedEnsembler(
+            mixture_weight_type=MixtureWeightType.MATRIX,
+            use_fused_combine=True,
+        )
+        params = ens.init_ensemble(jax.random.PRNGKey(0), members)
+        out = ens.build_ensemble(params, members)
+        # MATRIX falls back to the unfused path: member logits materialized.
+        assert out.weighted_subnetworks[0].logits is not None
+
+
 class TestMeanEnsembler:
     def test_mean_logits(self):
         members = _members(3)

@@ -1,0 +1,135 @@
+"""Experimental ModelFlow tests
+(reference: adanet/experimental/keras/model_search_test.py)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from adanet_tpu.experimental import (
+    AllStrategy,
+    AutoEnsemblePhase,
+    GrowStrategy,
+    InMemoryStorage,
+    InputPhase,
+    MeanEnsembler,
+    Model,
+    ModelContainer,
+    ModelSearch,
+    RandomKStrategy,
+    RepeatPhase,
+    SequentialController,
+    TrainerPhase,
+    TunerPhase,
+)
+
+
+class _MLP(nn.Module):
+    hidden: int = 8
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = jnp.asarray(features, jnp.float32)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)
+
+
+def _mse(logits, labels):
+    return jnp.mean(jnp.square(logits - jnp.asarray(labels, jnp.float32)))
+
+
+def _dataset(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) + 0.1 * rng.randn(64, 1)).astype(
+        np.float32
+    )
+
+    def data():
+        for s in range(0, 64, 16):
+            yield x[s : s + 16], y[s : s + 16]
+
+    return data
+
+
+def _model(hidden=8, lr=0.05, seed=0):
+    return Model(
+        _MLP(hidden),
+        loss_fn=_mse,
+        optimizer=optax.sgd(lr),
+        seed=seed,
+    )
+
+
+def test_storage_orders_by_score():
+    storage = InMemoryStorage()
+    storage.save_model(ModelContainer(2.0, "b", [2.0]))
+    storage.save_model(ModelContainer(1.0, "a", [1.0]))
+    storage.save_model(ModelContainer(3.0, "c", [3.0]))
+    assert storage.get_best_models(2) == ["a", "b"]
+    assert len(storage.get_models()) == 3
+
+
+def test_model_fit_reduces_loss():
+    model = _model()
+    before = model.evaluate(_dataset()())
+    model.fit(_dataset()(), epochs=10)
+    after = model.evaluate(_dataset()())
+    assert after[0] < before[0]
+
+
+def test_model_search_trainer_then_ensemble():
+    """ModelSearch pipeline: input -> train 2 models -> auto-ensemble
+    (reference: model_search_test.py)."""
+    phases = [
+        InputPhase(_dataset(0), _dataset(1)),
+        TrainerPhase([_model(8, seed=0), _model(16, seed=1)], epochs=5),
+        AutoEnsemblePhase(
+            ensemblers=[MeanEnsembler(_mse)],
+            ensemble_strategies=[GrowStrategy(), AllStrategy()],
+            num_candidates=2,
+        ),
+    ]
+    search = ModelSearch(SequentialController(phases))
+    search.run()
+    best = list(search.get_best_models(1))
+    assert len(best) == 1
+    loss = best[0].evaluate(_dataset(1)())[0]
+    assert np.isfinite(loss)
+
+
+def test_tuner_phase_random_search():
+    phases = [
+        InputPhase(_dataset(0), _dataset(1)),
+        TunerPhase(
+            build_model=lambda rng: _model(
+                hidden=rng.choice([4, 8, 16]), seed=rng.randint(0, 100)
+            ),
+            num_trials=3,
+            epochs=2,
+        ),
+    ]
+    search = ModelSearch(SequentialController(phases))
+    search.run()
+    assert len(list(search.get_best_models(3))) == 3
+
+
+def test_repeat_phase():
+    phases = [
+        InputPhase(_dataset(0), _dataset(1)),
+        RepeatPhase(
+            [lambda: TrainerPhase([_model(8)], epochs=1)],
+            repetitions=2,
+        ),
+    ]
+    search = ModelSearch(SequentialController(phases))
+    search.run()
+    assert len(list(search.get_best_models(1))) == 1
+
+
+def test_random_k_strategy():
+    groups = RandomKStrategy(k=3, seed=1)(["a", "b"])
+    assert len(groups) == 1
+    assert len(groups[0]) == 3
+    assert set(groups[0]) <= {"a", "b"}

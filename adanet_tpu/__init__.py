@@ -16,6 +16,7 @@ from adanet_tpu.autoensemble import AutoEnsembleEstimator
 from adanet_tpu.autoensemble import AutoEnsembleSubestimator
 from adanet_tpu.autoensemble import AutoEnsembleTPUEstimator
 from adanet_tpu.core.estimator import Estimator
+from adanet_tpu.core.tpu_estimator import TPUEstimator
 from adanet_tpu.core.evaluator import Evaluator
 from adanet_tpu.core.evaluator import Objective
 from adanet_tpu.core.heads import BinaryClassificationHead
@@ -38,6 +39,7 @@ __all__ = [
     "BinaryClassificationHead",
     "Builder",
     "Estimator",
+    "TPUEstimator",
     "distributed",
     "Evaluator",
     "Generator",

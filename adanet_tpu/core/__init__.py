@@ -19,6 +19,9 @@ from adanet_tpu.core.heads import RegressionHead
 from adanet_tpu.core.iteration import Iteration
 from adanet_tpu.core.iteration import IterationBuilder
 from adanet_tpu.core.report_accessor import ReportAccessor
+from adanet_tpu.core.summary import EventFileWriter
+from adanet_tpu.core.summary import ScopedSummary
+from adanet_tpu.core.tpu_estimator import TPUEstimator
 from adanet_tpu.core.report_materializer import ReportMaterializer
 
 __all__ = [
@@ -36,6 +39,9 @@ __all__ = [
     "MultiHead",
     "Objective",
     "RegressionHead",
+    "EventFileWriter",
     "ReportAccessor",
     "ReportMaterializer",
+    "ScopedSummary",
+    "TPUEstimator",
 ]

@@ -51,6 +51,26 @@ from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
 _LOG = logging.getLogger("adanet_tpu")
 
 
+def _crossed(prev_step: int, step: int, interval: int) -> bool:
+    """True when [prev_step, step] crossed a multiple of `interval` (steps
+    may advance by more than 1 under iterations_per_loop > 1)."""
+    return step // interval > prev_step // interval
+
+
+def _same_shapes(batches) -> bool:
+    """True when every batch pytree has identical leaf shapes."""
+    first = jax.tree_util.tree_map(lambda x: np.asarray(x).shape, batches[0])
+    first_leaves, first_def = jax.tree_util.tree_flatten(first)
+    for batch in batches[1:]:
+        shapes = jax.tree_util.tree_map(
+            lambda x: np.asarray(x).shape, batch
+        )
+        leaves, treedef = jax.tree_util.tree_flatten(shapes)
+        if treedef != first_def or leaves != first_leaves:
+            return False
+    return True
+
+
 class Estimator:
     """Drives the AdaNet search: train candidates, select, freeze, grow.
 
@@ -104,6 +124,10 @@ class Estimator:
         log_every_steps: int = 100,
         enable_summaries: bool = True,
         worker_wait_timeout_secs: float = 7200.0,
+        metric_fn: Optional[Callable] = None,
+        iterations_per_loop: int = 1,
+        profile_dir: Optional[str] = None,
+        profile_steps: int = 5,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -134,6 +158,14 @@ class Estimator:
         self._enable_summaries = bool(enable_summaries)
         self._summary: Optional[ScopedSummary] = None
         self._worker_wait_timeout_secs = float(worker_wait_timeout_secs)
+        # metric_fn(logits, labels) -> dict of extra eval metrics, the
+        # analogue of the reference Estimator's `metric_fn` kwarg.
+        self._metric_fn = metric_fn
+        if iterations_per_loop < 1:
+            raise ValueError("iterations_per_loop must be >= 1.")
+        self._iterations_per_loop = int(iterations_per_loop)
+        self._profile_dir = profile_dir
+        self._profile_steps = int(profile_steps)
 
         self._iteration_builder = IterationBuilder(
             head=head,
@@ -221,23 +253,80 @@ class Estimator:
                 info.global_step,
                 iteration.candidate_names(),
             )
+            profiling = False
+            profiled = False
             while steps_done < self._max_iteration_steps and (
                 max_steps is None or info.global_step < max_steps
             ):
-                batch, data_iter = self._next_batch(input_fn, data_iter)
-                extra_batches = {}
-                for name, fn in extra_input_fns.items():
-                    extra_batches[name], extra_iters[name] = (
-                        self._next_batch(fn, extra_iters.get(name))
+                if (
+                    self._profile_dir
+                    and not profiling
+                    and not profiled
+                    and coordination.is_chief()
+                ):
+                    # Trace the first steps of each iteration
+                    # (the aux tracing subsystem; SURVEY.md §5.1).
+                    jax.profiler.start_trace(
+                        os.path.join(
+                            self._profile_dir, "iteration_%d" % t
+                        )
                     )
-                state, metrics = iteration.train_step(
-                    state, batch, extra_batches
-                )
-                steps_done += 1
-                info.global_step += 1
+                    profiling = True
+                    profile_stop_at = steps_done + self._profile_steps
+
+                steps_budget = self._max_iteration_steps - steps_done
+                if max_steps is not None:
+                    steps_budget = min(
+                        steps_budget, max_steps - info.global_step
+                    )
+                loop_size = min(self._iterations_per_loop, steps_budget)
+                prev_steps_done = steps_done
+                if loop_size > 1 and not extra_input_fns:
+                    batches = []
+                    for _ in range(loop_size):
+                        batch, data_iter = self._next_batch(
+                            input_fn, data_iter
+                        )
+                        batches.append(batch)
+                    if _same_shapes(batches):
+                        stacked = jax.tree_util.tree_map(
+                            lambda *xs: np.stack(xs), *batches
+                        )
+                        state, metrics = iteration.train_steps(
+                            state, stacked
+                        )
+                    else:
+                        # Ragged batch in the window (e.g. a short final
+                        # batch): fall back to single steps.
+                        for batch in batches:
+                            state, metrics = iteration.train_step(
+                                state, batch
+                            )
+                    steps_done += loop_size
+                    info.global_step += loop_size
+                else:
+                    batch, data_iter = self._next_batch(input_fn, data_iter)
+                    extra_batches = {}
+                    for name, fn in extra_input_fns.items():
+                        extra_batches[name], extra_iters[name] = (
+                            self._next_batch(fn, extra_iters.get(name))
+                        )
+                    state, metrics = iteration.train_step(
+                        state, batch, extra_batches
+                    )
+                    steps_done += 1
+                    info.global_step += 1
+
+                if profiling and steps_done >= profile_stop_at:
+                    jax.block_until_ready(metrics)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    profiled = True  # one trace window per iteration
                 if (
                     self._log_every_steps
-                    and steps_done % self._log_every_steps == 0
+                    and _crossed(
+                        prev_steps_done, steps_done, self._log_every_steps
+                    )
                     and coordination.is_chief()
                 ):
                     emas = iteration.ema_losses(state)
@@ -253,10 +342,18 @@ class Estimator:
                     )
                 if (
                     self._save_checkpoint_steps
-                    and steps_done % self._save_checkpoint_steps == 0
+                    and _crossed(
+                        prev_steps_done,
+                        steps_done,
+                        self._save_checkpoint_steps,
+                    )
                     and coordination.is_chief()
                 ):
                     self._save_iteration_state(info, t, state)
+
+            if profiling:
+                jax.profiler.stop_trace()
+                profiling = False
 
             if steps_done < self._max_iteration_steps:
                 # Interrupted by max_steps: persist mid-iteration and stop.
@@ -634,6 +731,8 @@ class Estimator:
             ensemble = forward(features)
             out = dict(self._head.eval_metrics(ensemble.logits, labels))
             out["loss"] = self._head.loss(ensemble.logits, labels)
+            if self._metric_fn is not None:
+                out.update(self._metric_fn(ensemble.logits, labels))
             return out
 
         totals: Dict[str, float] = {}
@@ -646,6 +745,18 @@ class Estimator:
                 totals[key] = totals.get(key, 0.0) + float(value)
             count += 1
         result = {key: value / count for key, value in totals.items()}
+        if self._enable_summaries and coordination.is_chief():
+            # Per-candidate eval event dirs, the reference's
+            # <model_dir>/ensemble/<name>/eval layout
+            # (reference: adanet/core/estimator.py:1683-1723).
+            summary = ScopedSummary(self._model_dir)
+            summary.scalars(
+                "ensemble",
+                os.path.join(name, "eval"),
+                result,
+                self.latest_global_step(),
+            )
+            summary.close()
         result["best_ensemble"] = name
         result["global_step"] = self.latest_global_step()
         return result

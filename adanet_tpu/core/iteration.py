@@ -158,6 +158,9 @@ class Iteration:
         self._spec_by_name = {s.name: s for s in self.ensemble_specs}
 
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        self._train_multi_step = jax.jit(
+            self._train_multi_step_impl, donate_argnums=0
+        )
         self._eval_step = jax.jit(self._eval_step_impl)
 
     # ------------------------------------------------------------------ init
@@ -308,6 +311,28 @@ class Iteration:
         (reference: adanet/autoensemble/common.py:59-93).
         """
         return self._train_step(state, batch, dict(extra_batches or {}))
+
+    def train_steps(self, state: IterationState, stacked_batch):
+        """K fused train steps in ONE device dispatch via `lax.scan`.
+
+        The host-loop batching analogue of TPUEstimator's
+        `iterations_per_loop` (reference: adanet/core/tpu_estimator.py:91-178
+        runs N steps per device loop via infeed): `stacked_batch` is a
+        (features, labels) pytree whose leaves have a leading `K` dimension
+        (K stacked batches). Returns (state, metrics-of-last-step). Host
+        NaN/logging checks happen once per K steps, as on the reference TPU
+        path.
+        """
+        return self._train_multi_step(state, stacked_batch)
+
+    def _train_multi_step_impl(self, state, stacked_batch):
+        def body(s, batch):
+            new_s, metrics = self._train_step_impl(s, batch, {})
+            return new_s, metrics
+
+        state, metrics = jax.lax.scan(body, state, stacked_batch)
+        # Report the last step's metrics (cheap; full series stays on device).
+        return state, jax.tree_util.tree_map(lambda m: m[-1], metrics)
 
     def _apply_subnetwork(
         self, spec, variables, features, training, rngs=None

@@ -1,0 +1,28 @@
+"""TPUEstimator: the TPU-tuned Estimator facade.
+
+The reference needs a separate TPU class stitching TPUEstimatorSpec,
+infeed/outfeed, and host calls over the CPU Estimator
+(reference: adanet/core/tpu_estimator.py:91-430). This engine is TPU-native
+throughout, so `TPUEstimator` is the same search loop with TPU-friendly
+defaults turned on:
+
+- `iterations_per_loop=16`: K fused train steps per host dispatch via
+  `lax.scan` (the infeed/device-loop analogue), amortizing host round
+  trips; host-side NaN/logging checks run once per loop, exactly as the
+  reference's TPU path checks once per device loop.
+- summaries/metrics remain host-side floats — no host_call machinery is
+  needed because metrics are ordinary jitted-step outputs.
+"""
+
+from __future__ import annotations
+
+from adanet_tpu.core.estimator import Estimator
+
+
+class TPUEstimator(Estimator):
+    """`Estimator` with TPU host-loop batching defaults."""
+
+    def __init__(self, *args, iterations_per_loop: int = 16, **kwargs):
+        super().__init__(
+            *args, iterations_per_loop=iterations_per_loop, **kwargs
+        )

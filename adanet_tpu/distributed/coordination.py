@@ -22,6 +22,7 @@ is the planned data path; the mesh/sharding layer in
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Optional
 
@@ -52,9 +53,28 @@ def initialize(
     )
 
 
+_process_index_override: Optional[int] = None
+
+
+def set_process_index_for_testing(index: Optional[int]) -> None:
+    """Explicit role override for the multi-process test harness (the
+    analogue of the reference's synthesized TF_CONFIG task indices,
+    estimator_distributed_test.py:46-88). Deliberately an in-process
+    setter, not an env var, so stray environment state can never fork two
+    chiefs or leave a run chiefless."""
+    global _process_index_override
+    _process_index_override = index
+
+
+def process_index() -> int:
+    if _process_index_override is not None:
+        return _process_index_override
+    return jax.process_index()
+
+
 def is_chief() -> bool:
     """Process 0 runs bookkeeping (selection, reports, checkpoints)."""
-    return jax.process_index() == 0
+    return process_index() == 0
 
 
 class WorkerWaitTimeout(TimeoutError):

@@ -1,0 +1,104 @@
+"""AutoEnsemble on Boston-housing-style regression (BASELINE config 1).
+
+Analogue of the reference's AutoEnsemble tutorial
+(reference: adanet/examples/tutorials/adanet_objective.ipynb and BASELINE.md
+"Boston Housing regression AutoEnsembleEstimator (linear + 2-layer DNN
+candidates)"). The real dataset cannot be downloaded in this zero-egress
+environment; pass --data_npz pointing at an .npz with arrays `x` and `y`,
+or run on a synthetic stand-in with the same shape (506 x 13).
+
+Run: python -m adanet_tpu.examples.tutorials.boston_housing
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+import adanet_tpu
+from adanet_tpu import AutoEnsembleEstimator, AutoEnsembleSubestimator
+from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+
+
+class Linear(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        return nn.Dense(1)(jnp.asarray(features["x"], jnp.float32))
+
+
+class DNN(nn.Module):
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = jnp.asarray(features["x"], jnp.float32)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)
+
+
+def load_data(data_npz: str | None):
+    if data_npz:
+        data = np.load(data_npz)
+        x, y = data["x"].astype(np.float32), data["y"].astype(np.float32)
+    else:
+        rng = np.random.RandomState(7)
+        x = rng.randn(506, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        y = x @ w + 0.5 * rng.randn(506).astype(np.float32)
+    y = y.reshape(-1, 1)
+    x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+    split = int(0.8 * len(x))
+    return (x[:split], y[:split]), (x[split:], y[split:])
+
+
+def make_input_fn(x, y, batch_size=32):
+    def input_fn():
+        n = (len(x) // batch_size) * batch_size
+        for start in range(0, n, batch_size):
+            yield (
+                {"x": x[start : start + batch_size]},
+                y[start : start + batch_size],
+            )
+
+    return input_fn
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data_npz", default=None)
+    parser.add_argument("--model_dir", default="/tmp/boston_autoensemble")
+    parser.add_argument("--max_steps", type=int, default=600)
+    parser.add_argument("--iterations", type=int, default=3)
+    args = parser.parse_args()
+
+    (train_x, train_y), (test_x, test_y) = load_data(args.data_npz)
+    estimator = AutoEnsembleEstimator(
+        head=adanet_tpu.RegressionHead(),
+        candidate_pool={
+            "linear": AutoEnsembleSubestimator(
+                Linear(), optax.sgd(0.01, momentum=0.9)
+            ),
+            "dnn": AutoEnsembleSubestimator(
+                DNN(), optax.adam(1e-3)
+            ),
+        },
+        max_iteration_steps=args.max_steps // args.iterations,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.01))
+        ],
+        max_iterations=args.iterations,
+        model_dir=args.model_dir,
+    )
+    estimator.train(make_input_fn(train_x, train_y), max_steps=args.max_steps)
+    metrics = estimator.evaluate(make_input_fn(test_x, test_y))
+    print("Test metrics:", metrics)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,11 +72,21 @@ class Model:
         return self.module.apply(self.variables, features, training=training)
 
     def fit(self, dataset: Iterable, epochs: int = 1) -> "Model":
-        """Trains over the dataset; `dataset` yields (features, labels)."""
+        """Trains over the dataset; `dataset` yields (features, labels),
+        or is a zero-arg callable returning such an iterable (required to
+        be a callable or re-iterable when epochs > 1 — a one-shot iterator
+        is materialized so later epochs aren't silently empty)."""
         if not self.trainable:
             return self
         if self.loss_fn is None or self.optimizer is None:
             raise ValueError("Model must be compiled before fit().")
+        if callable(dataset):
+            get_epoch = dataset
+        elif epochs > 1 and iter(dataset) is dataset:
+            batches = list(dataset)
+            get_epoch = lambda: batches
+        else:
+            get_epoch = lambda: dataset
 
         @jax.jit
         def step(variables, opt_state, features, labels):
@@ -94,7 +104,7 @@ class Model:
             return {**variables, "params": params}, opt_state, value
 
         for _ in range(epochs):
-            for features, labels in dataset:
+            for features, labels in get_epoch():
                 self._ensure_initialized(features)
                 self.variables, self._opt_state, _ = step(
                     self.variables, self._opt_state, features, labels

@@ -84,16 +84,24 @@ class Provider:
         self,
         partition: str = "train",
         shuffle: Optional[bool] = None,
-        epoch_seed: int = 0,
     ):
-        """Zero-arg callable yielding ({'image': ...}, labels) batches."""
+        """Zero-arg callable yielding ({'image': ...}, labels) batches.
+
+        Each invocation (= each epoch; the Estimator re-invokes on
+        exhaustion) reshuffles and re-augments with a fresh per-epoch seed,
+        like the reference tf.data pipeline. Deterministic given the
+        provider seed and epoch count since construction.
+        """
         if shuffle is None:
             shuffle = partition == "train"
         augment = partition == "train"
+        epoch_counter = {"epoch": 0}
 
         def input_fn() -> Iterator:
+            epoch = epoch_counter["epoch"]
+            epoch_counter["epoch"] += 1
             images, labels = self._load(partition)
-            rng = np.random.RandomState(self._seed + epoch_seed)
+            rng = np.random.RandomState(self._seed + epoch)
             order = np.arange(len(images))
             if shuffle:
                 rng.shuffle(order)

@@ -1,0 +1,61 @@
+"""Per-process runner for the multi-process distributed test.
+
+The analogue of the reference's per-task runner
+(reference: adanet/core/estimator_distributed_test_runner.py): invoked as a
+subprocess per role (chief / worker) with a shared model_dir; trains the
+same deterministic search and exits 0 on success.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import optax
+
+import adanet_tpu
+from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+from adanet_tpu.subnetwork import SimpleGenerator
+
+from helpers import DNNBuilder, linear_dataset
+
+
+def main():
+    model_dir = sys.argv[1]
+    role_index = int(sys.argv[2])
+    from adanet_tpu.distributed import coordination
+
+    coordination.set_process_index_for_testing(role_index)
+    estimator = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("dnn", 1), DNNBuilder("deep", 2)]
+        ),
+        max_iteration_steps=6,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        max_iterations=2,
+        model_dir=model_dir,
+        log_every_steps=0,
+        worker_wait_timeout_secs=120.0,
+    )
+    estimator.train(linear_dataset(), max_steps=100)
+    assert estimator.latest_iteration_number() == 2, (
+        "expected 2 iterations, got %d"
+        % estimator.latest_iteration_number()
+    )
+    metrics = estimator.evaluate(linear_dataset())
+    assert metrics["average_loss"] == metrics["average_loss"]  # not NaN
+    print("ROLE %d DONE" % role_index)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,8 @@ single-controller JAX: submesh partitioning, data-parallel sharding, and
 candidate-parallel RoundRobin execution.
 """
 
+import os
+
 import jax
 import numpy as np
 import optax
@@ -152,6 +154,36 @@ def test_worker_wait_for_iteration(tmp_path):
         wait_for_iteration(
             model_dir, 2, timeout_secs=0.2, poll_interval_secs=0.05
         )
+
+
+def test_multi_process_chief_worker(tmp_path):
+    """Spawns real OS subprocesses for chief + worker roles sharing a
+    model_dir — the analogue of the reference's TF_CONFIG subprocess grid
+    (reference: adanet/core/estimator_distributed_test.py:281-334)."""
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(__file__), "distributed_runner.py")
+    model_dir = str(tmp_path / "shared_model")
+
+    def spawn(index):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.Popen(
+            [sys.executable, runner, model_dir, str(index)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    chief = spawn(0)
+    worker = spawn(1)
+    chief_out, _ = chief.communicate(timeout=600)
+    worker_out, _ = worker.communicate(timeout=600)
+    assert chief.returncode == 0, chief_out.decode()[-2000:]
+    assert worker.returncode == 0, worker_out.decode()[-2000:]
+    assert b"ROLE 0 DONE" in chief_out
+    assert b"ROLE 1 DONE" in worker_out
 
 
 def test_round_robin_executor_stale_sync():

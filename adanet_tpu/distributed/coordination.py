@@ -22,7 +22,6 @@ is the planned data path; the mesh/sharding layer in
 from __future__ import annotations
 
 import logging
-import os
 import time
 from typing import Optional
 

@@ -1,5 +1,17 @@
 """Model zoo: TPU-first Flax implementations of workload architectures."""
 
 from adanet_tpu.models.nasnet import NasNetA, NasNetConfig, calc_reduction_layers
+from adanet_tpu.models.transformer import (
+    TransformerBuilder,
+    TransformerConfig,
+    TransformerEncoder,
+)
 
-__all__ = ["NasNetA", "NasNetConfig", "calc_reduction_layers"]
+__all__ = [
+    "NasNetA",
+    "NasNetConfig",
+    "TransformerBuilder",
+    "TransformerConfig",
+    "TransformerEncoder",
+    "calc_reduction_layers",
+]

@@ -1,0 +1,191 @@
+"""Transformer encoder subnetworks with optional sequence parallelism.
+
+A model family the reference never had (it predates long-context work,
+SURVEY.md §5.7), included because long-context support is first-class in
+this framework: attention can run as exact ring attention with the sequence
+axis sharded over a mesh (`adanet_tpu.parallel.ring_attention`), so AdaNet
+searches can include long-sequence candidates.
+
+TPU-first: bfloat16 matmuls with float32 layernorm/softmax accumulations,
+static shapes, einsum-based attention that XLA tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from adanet_tpu.parallel.ring_attention import full_attention, ring_attention
+from adanet_tpu.subnetwork import Builder, Subnetwork
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 2
+    num_heads: int = 4
+    model_dim: int = 128
+    mlp_dim: int = 512
+    max_seq_len: int = 2048
+    dropout: float = 0.0
+    causal: bool = True
+    compute_dtype: Any = jnp.bfloat16
+    # Sequence parallelism: mesh + axis to ring-shard attention over.
+    sp_mesh: Optional[Mesh] = None
+    sp_axis: str = "sp"
+
+
+class _Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        cfg = self.config
+        heads, dim = cfg.num_heads, cfg.model_dim // cfg.num_heads
+        qkv = nn.DenseGeneral(
+            (3, heads, dim),
+            use_bias=False,
+            dtype=cfg.compute_dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        if cfg.sp_mesh is not None:
+            out = ring_attention(
+                q,
+                k,
+                v,
+                cfg.sp_mesh,
+                axis_name=cfg.sp_axis,
+                causal=cfg.causal,
+            )
+        else:
+            out = full_attention(q, k, v, causal=cfg.causal)
+        return nn.DenseGeneral(
+            cfg.model_dim,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.compute_dtype,
+            name="proj",
+        )(out)
+
+
+class _Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        cfg = self.config
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        y = _Attention(cfg, name="attention")(y, training)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout, deterministic=not training)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = nn.Dense(
+            cfg.mlp_dim, dtype=cfg.compute_dtype, name="mlp_in"
+        )(y)
+        y = nn.gelu(y)
+        y = nn.Dense(
+            cfg.model_dim, dtype=cfg.compute_dtype, name="mlp_out"
+        )(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout, deterministic=not training)(y)
+        return x + y
+
+
+class TransformerEncoder(nn.Module):
+    """Token ids [batch, seq] -> (pooled [batch, dim], per-token features)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, token_ids, training: bool = False):
+        cfg = self.config
+        if token_ids.shape[1] > cfg.max_seq_len:
+            raise ValueError(
+                "Sequence length %d exceeds max_seq_len %d (position "
+                "embeddings would silently clamp)."
+                % (token_ids.shape[1], cfg.max_seq_len)
+            )
+        x = nn.Embed(
+            cfg.vocab_size,
+            cfg.model_dim,
+            dtype=cfg.compute_dtype,
+            name="embed",
+        )(token_ids)
+        positions = jnp.arange(token_ids.shape[1])
+        x = x + nn.Embed(
+            cfg.max_seq_len,
+            cfg.model_dim,
+            dtype=cfg.compute_dtype,
+            name="pos_embed",
+        )(positions)[None]
+        for i in range(cfg.num_layers):
+            x = _Block(cfg, name="block_%d" % i)(x, training)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        pooled = jnp.asarray(jnp.mean(x, axis=1), jnp.float32)
+        return pooled, x
+
+
+class _TransformerSubnetworkModule(nn.Module):
+    config: TransformerConfig
+    logits_dimension: int
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        tokens = (
+            features["tokens"] if isinstance(features, dict) else features
+        )
+        pooled, _ = TransformerEncoder(self.config, name="encoder")(
+            tokens, training=training
+        )
+        logits = nn.Dense(
+            self.logits_dimension, dtype=jnp.float32, name="logits"
+        )(pooled)
+        cfg = self.config
+        return Subnetwork(
+            last_layer=pooled,
+            logits=logits,
+            complexity=math.sqrt(cfg.num_layers),
+            shared={
+                "num_layers": cfg.num_layers,
+                "model_dim": cfg.model_dim,
+            },
+        )
+
+
+class TransformerBuilder(Builder):
+    """AdaNet builder over transformer encoders (sequence classification)."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        optimizer=None,
+        name: Optional[str] = None,
+    ):
+        import optax
+
+        self._config = config
+        self._optimizer = optimizer or optax.adamw(1e-3)
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name or "transformer_%dl_%dd" % (
+            self._config.num_layers,
+            self._config.model_dim,
+        )
+
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        return _TransformerSubnetworkModule(
+            config=self._config, logits_dimension=logits_dimension
+        )
+
+    def build_train_optimizer(self, previous_ensemble=None):
+        return self._optimizer

@@ -1,0 +1,11 @@
+"""Sequence/context parallelism primitives.
+
+Long-context support is first-class in this framework (the reference has
+none; SURVEY.md §5.7): ring attention shards the sequence axis across the
+mesh with exact results. Device placement and data parallelism live in
+`adanet_tpu.distributed`.
+"""
+
+from adanet_tpu.parallel.ring_attention import full_attention, ring_attention
+
+__all__ = ["full_attention", "ring_attention"]

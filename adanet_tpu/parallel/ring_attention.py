@@ -1,0 +1,173 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+Sequence/context parallelism for long sequences (Liu et al., "Ring
+Attention with Blockwise Transformers", arXiv:2310.01889 — see PAPERS.md):
+queries stay resident on their device while key/value blocks rotate around
+the mesh's sequence axis via `jax.lax.ppermute` (one ICI hop per step), and
+softmax is accumulated online flash-style, so attention over the full
+sequence is exact with per-device memory O(seq/num_devices).
+
+The reference framework predates long-context work (SURVEY.md §5.7); this
+module is the first-class TPU-native capability the new framework adds:
+compute rides the MXU in blocks, communication rides ICI, and everything
+compiles into the surrounding jitted train step via `shard_map`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, acc, row_max, row_sum, mask):
+    """One flash-style online-softmax update with a new kv block.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; acc: [B, Sq, H, D] f32;
+    row_max/row_sum: [B, Sq, H] f32; mask: [Sq, Sk] bool (True = keep).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bqhk",
+        jnp.asarray(q, jnp.float32),
+        jnp.asarray(k, jnp.float32),
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    scores = jnp.where(mask[None, :, None, :], scores, _NEG_INF)
+
+    block_max = jnp.max(scores, axis=-1)  # [B, Sq, H]
+    new_max = jnp.maximum(row_max, block_max)
+    # Rescale previous accumulators to the new max.
+    correction = jnp.exp(row_max - new_max)
+    probs = jnp.exp(scores - new_max[..., None])  # [B, Sq, H, Sk]
+    block_sum = jnp.sum(probs, axis=-1)
+    new_sum = row_sum * correction + block_sum
+    block_out = jnp.einsum(
+        "bqhk,bkhd->bqhd", probs, jnp.asarray(v, jnp.float32)
+    )
+    new_acc = acc * correction[..., None] + block_out
+    return new_acc, new_max, new_sum
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool, seq_per_device: int):
+    """Per-device ring loop (runs inside shard_map)."""
+    num_devices = jax.lax.psum(1, axis_name)
+    device_idx = jax.lax.axis_index(axis_name)
+    batch, sq, heads, d = q.shape
+
+    # Mark the accumulators as varying over the ring axis so the scan carry
+    # types line up with the ppermute-rotated kv blocks.
+    acc, row_max, row_sum = jax.lax.pcast(
+        (
+            jnp.zeros((batch, sq, heads, d), jnp.float32),
+            jnp.full((batch, sq, heads), _NEG_INF, jnp.float32),
+            jnp.zeros((batch, sq, heads), jnp.float32),
+        ),
+        (axis_name,),
+        to="varying",
+    )
+
+    q_pos = device_idx * seq_per_device + jnp.arange(sq)
+
+    def attend(k_blk, v_blk, acc, row_max, row_sum, ring_step):
+        # This kv block originated on device (device_idx - ring_step) mod p.
+        src = jnp.mod(device_idx - ring_step, num_devices)
+        k_pos = src * seq_per_device + jnp.arange(k_blk.shape[1])
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((sq, k_blk.shape[1]), bool)
+        return _block_attention(
+            q, k_blk, v_blk, acc, row_max, row_sum, mask
+        )
+
+    def step(carry, ring_step):
+        k_blk, v_blk, acc, row_max, row_sum = carry
+        acc, row_max, row_sum = attend(
+            k_blk, v_blk, acc, row_max, row_sum, ring_step
+        )
+        # Rotate kv around the ring (one ICI hop).
+        perm = [
+            (i, (i + 1) % num_devices) for i in range(num_devices)
+        ]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc, row_max, row_sum), None
+
+    # Scan over the first p-1 blocks (each ending with a rotate); the last
+    # block attends outside the scan so no wasted final ICI hop occurs.
+    (k, v, acc, row_max, row_sum), _ = jax.lax.scan(
+        step,
+        (k, v, acc, row_max, row_sum),
+        jnp.arange(num_devices - 1),
+    )
+    acc, row_max, row_sum = attend(
+        k, v, acc, row_max, row_sum, num_devices - 1
+    )
+    out = acc / row_sum[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+):
+    """Exact multi-head attention with the sequence sharded over `axis_name`.
+
+    Args:
+      q, k, v: [batch, seq, heads, head_dim] arrays; `seq` is (or will be)
+        sharded over the mesh axis `axis_name`.
+      mesh: the device mesh containing `axis_name`.
+      axis_name: the sequence-parallel mesh axis.
+      causal: apply a causal mask over *global* positions.
+
+    Returns:
+      [batch, seq, heads, head_dim] attention output, sequence-sharded the
+      same way.
+    """
+    num_devices = mesh.shape[axis_name]
+    seq = q.shape[1]
+    if seq % num_devices != 0:
+        raise ValueError(
+            "Sequence length %d must be divisible by the %r axis size %d."
+            % (seq, axis_name, num_devices)
+        )
+    seq_per_device = seq // num_devices
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(
+        _ring_body,
+        axis_name=axis_name,
+        causal=causal,
+        seq_per_device=seq_per_device,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Single-device reference attention (the correctness oracle)."""
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bqhk",
+        jnp.asarray(q, jnp.float32),
+        jnp.asarray(k, jnp.float32),
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        sq, sk = scores.shape[1], scores.shape[3]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bqhk,bkhd->bqhd", probs, jnp.asarray(v, jnp.float32))
+    return out.astype(q.dtype)

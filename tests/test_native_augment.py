@@ -1,0 +1,76 @@
+"""Native C++ augmentation kernel vs numpy oracle."""
+
+import time
+
+import numpy as np
+import pytest
+
+from adanet_tpu.ops import native_augment
+from research.improve_nas.trainer import image_processing
+
+
+def _images(n=32, h=32, w=32, c=3, seed=0):
+    return np.random.RandomState(seed).rand(n, h, w, c).astype(np.float32)
+
+
+def test_native_builds():
+    assert native_augment.get_lib() is not None, "g++ build failed"
+
+
+def test_native_matches_numpy_exactly():
+    images = _images()
+    rng = np.random.RandomState(1)
+    n, h, w, _ = images.shape
+    offsets = image_processing.sample_offsets(n, h, w, rng, pad=4)
+    native = native_augment.augment_apply(images, *offsets, pad=4, cutout=16)
+    oracle = image_processing.apply_numpy(images, *offsets, pad=4, cutout=16)
+    np.testing.assert_array_equal(native, oracle)
+
+
+def test_native_matches_numpy_no_cutout_and_edge_offsets():
+    images = _images(n=4, h=8, w=8)
+    n, h, w, _ = images.shape
+    # Extreme offsets: full-pad shifts, all flips on.
+    tops = np.full(n, 8, np.int32)
+    lefts = np.zeros(n, np.int32)
+    flips = np.ones(n, np.uint8)
+    cys = np.zeros(n, np.int32)
+    cxs = np.full(n, w - 1, np.int32)
+    native = native_augment.augment_apply(
+        images, tops, lefts, flips, cys, cxs, pad=4, cutout=0
+    )
+    oracle = image_processing.apply_numpy(
+        images, tops, lefts, flips, cys, cxs, pad=4, cutout=0
+    )
+    np.testing.assert_array_equal(native, oracle)
+
+
+def test_augment_batch_backends_agree():
+    images = _images(n=8)
+    out_native = image_processing.augment_batch(
+        images, np.random.RandomState(7), backend="native"
+    )
+    out_numpy = image_processing.augment_batch(
+        images, np.random.RandomState(7), backend="numpy"
+    )
+    np.testing.assert_array_equal(out_native, out_numpy)
+
+
+def test_native_is_faster_than_numpy():
+    images = _images(n=256)
+    n, h, w, _ = images.shape
+    rng = np.random.RandomState(0)
+    offsets = image_processing.sample_offsets(n, h, w, rng, pad=4)
+
+    t0 = time.perf_counter()
+    for _ in range(5):
+        native_augment.augment_apply(images, *offsets, pad=4, cutout=16)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(5):
+        image_processing.apply_numpy(images, *offsets, pad=4, cutout=16)
+    t_numpy = time.perf_counter() - t0
+    # Not a strict benchmark; just guard against the native path being
+    # pathologically slow.
+    assert t_native < t_numpy * 2.0

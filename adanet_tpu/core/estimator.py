@@ -128,6 +128,7 @@ class Estimator:
         iterations_per_loop: int = 1,
         profile_dir: Optional[str] = None,
         profile_steps: int = 5,
+        debug: bool = False,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -166,6 +167,10 @@ class Estimator:
         self._iterations_per_loop = int(iterations_per_loop)
         self._profile_dir = profile_dir
         self._profile_steps = int(profile_steps)
+        # debug=True validates every batch for non-finite values before it
+        # reaches the device, the analogue of the reference's debug-mode
+        # feature/label NaN asserts (reference: estimator.py:386-439).
+        self._debug = bool(debug)
 
         self._iteration_builder = IterationBuilder(
             head=head,
@@ -381,13 +386,32 @@ class Estimator:
         if data_iter is None:
             data_iter = iter(input_fn())
         try:
-            return next(data_iter), data_iter
+            batch = next(data_iter)
         except StopIteration:
             data_iter = iter(input_fn())
             try:
-                return next(data_iter), data_iter
+                batch = next(data_iter)
             except StopIteration:
                 raise ValueError("input_fn yielded no batches.")
+        if self._debug:
+            self._check_batch_finite(batch)
+        return batch, data_iter
+
+    @staticmethod
+    def _check_batch_finite(batch):
+        for path, leaf in jax.tree_util.tree_leaves_with_path(batch):
+            arr = np.asarray(leaf)
+            # "float" in dtype.name also covers ml_dtypes like bfloat16,
+            # whose numpy kind is 'V' and which np.issubdtype misses.
+            if "float" not in arr.dtype.name:
+                continue
+            if arr.dtype.kind != "f":
+                arr = arr.astype(np.float32)
+            if not np.all(np.isfinite(arr)):
+                raise FloatingPointError(
+                    "Non-finite values in input batch at %s (debug=True)."
+                    % jax.tree_util.keystr(path)
+                )
 
     def _write_train_summaries(self, iteration, metrics, emas, global_step):
         """Scoped per-candidate TensorBoard scalars.
@@ -712,18 +736,46 @@ class Estimator:
 
         return jax.jit(forward), frozen.name
 
+    def _bootstrap_input(self, input_fn):
+        """First batch + re-chained iterator (errors on empty input)."""
+        data = iter(input_fn())
+        try:
+            first = next(data)
+        except StopIteration:
+            raise ValueError("input_fn yielded no batches.")
+        return first, itertools.chain([first], data)
+
+    def _eval_batches(self, data, steps):
+        """Yields up to `steps` batches, debug-checked like training ones."""
+        count = 0
+        for batch in data:
+            if steps is not None and count >= steps:
+                break
+            if self._debug:
+                self._check_batch_finite(batch)
+            yield batch
+            count += 1
+
+    def _write_eval_summaries(self, per_scope, global_step):
+        """Per-candidate eval event dirs, the reference's
+        <model_dir>/ensemble/<name>/eval layout
+        (reference: adanet/core/estimator.py:1683-1723)."""
+        if not (self._enable_summaries and coordination.is_chief()):
+            return
+        summary = ScopedSummary(self._model_dir)
+        for name, metrics in per_scope.items():
+            summary.scalars(
+                "ensemble", os.path.join(name, "eval"), metrics, global_step
+            )
+        summary.close()
+
     def evaluate(
         self,
         input_fn: Callable[[], Iterator],
         steps: Optional[int] = None,
     ) -> Dict[str, float]:
         """Evaluates the best ensemble; returns averaged metrics."""
-        data = iter(input_fn())
-        try:
-            first = next(data)
-        except StopIteration:
-            raise ValueError("input_fn yielded no batches.")
-        data = itertools.chain([first], data)
+        first, data = self._bootstrap_input(input_fn)
         forward, name = self._final_forward_fn(first)
 
         @jax.jit
@@ -737,29 +789,56 @@ class Estimator:
 
         totals: Dict[str, float] = {}
         count = 0
-        for features, labels in data:
-            if steps is not None and count >= steps:
-                break
+        for features, labels in self._eval_batches(data, steps):
             host = jax.device_get(metrics_fn(features, labels))
             for key, value in host.items():
                 totals[key] = totals.get(key, 0.0) + float(value)
             count += 1
         result = {key: value / count for key, value in totals.items()}
-        if self._enable_summaries and coordination.is_chief():
-            # Per-candidate eval event dirs, the reference's
-            # <model_dir>/ensemble/<name>/eval layout
-            # (reference: adanet/core/estimator.py:1683-1723).
-            summary = ScopedSummary(self._model_dir)
-            summary.scalars(
-                "ensemble",
-                os.path.join(name, "eval"),
-                result,
-                self.latest_global_step(),
-            )
-            summary.close()
+        self._write_eval_summaries({name: result}, self.latest_global_step())
         result["best_ensemble"] = name
         result["global_step"] = self.latest_global_step()
         return result
+
+    def evaluate_all_candidates(
+        self,
+        input_fn: Callable[[], Iterator],
+        steps: Optional[int] = None,
+    ) -> Dict[str, Dict[str, float]]:
+        """Per-candidate metrics over a dataset (current iteration).
+
+        The analogue of the reference's per-candidate eval event dirs
+        (reference: adanet/core/estimator.py:1683-1723): every candidate
+        ensemble's metrics are computed in one pass and written to
+        `<model_dir>/ensemble/<name>/eval`. Requires a checkpoint with
+        live (mid-iteration) candidate state.
+        """
+        info = ckpt_lib.read_manifest(self._model_dir)
+        if info is None or not info.iteration_state_file:
+            raise ValueError(
+                "evaluate_all_candidates needs a mid-iteration checkpoint; "
+                "after an iteration completes only the winner remains."
+            )
+        first, data = self._bootstrap_input(input_fn)
+        iteration = self._build_iteration(info.iteration_number, first)
+        state = self._init_or_restore_state(iteration, first, info)
+
+        names = iteration.candidate_names()
+        totals: Dict[str, Dict[str, float]] = {n: {} for n in names}
+        count = 0
+        for batch in self._eval_batches(data, steps):
+            results = iteration.eval_step(state, batch)
+            host = jax.device_get({n: results[n] for n in names})
+            for n in names:
+                for key, value in host[n].items():
+                    totals[n][key] = totals[n].get(key, 0.0) + float(value)
+            count += 1
+        results = {
+            n: {key: value / count for key, value in metrics.items()}
+            for n, metrics in totals.items()
+        }
+        self._write_eval_summaries(results, info.global_step)
+        return results
 
     def predict(self, input_fn: Callable[[], Iterator]):
         """Yields per-batch prediction dicts of the best ensemble."""
@@ -777,7 +856,7 @@ class Estimator:
             ensemble = forward(features)
             return self._head.predictions(ensemble.logits)
 
-        for batch in data:
+        for batch in self._eval_batches(data, None):
             features = batch[0] if isinstance(batch, tuple) else batch
             yield jax.device_get(predict_fn(features))
 

@@ -1,0 +1,238 @@
+"""Unit tests for the durable-state and bookkeeping modules.
+
+Coverage analogue of the reference's unit suites: architecture_test.py,
+report_accessor_test.py, evaluator_test.py, candidate_test.py, timer_test.py.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adanet_tpu.core import checkpoint as ckpt_lib
+from adanet_tpu.core.architecture import Architecture
+from adanet_tpu.core.candidate import (
+    debiased_ema,
+    initial_candidate_state,
+    update_candidate_state,
+)
+from adanet_tpu.core.evaluator import Evaluator, Objective
+from adanet_tpu.core.report_accessor import ReportAccessor
+from adanet_tpu.core.timer import CountDownTimer
+from adanet_tpu.subnetwork import MaterializedReport
+from adanet_tpu import replay
+
+
+class TestArchitecture:
+    def test_serialize_round_trip(self):
+        arch = Architecture("cand", "complexity_regularized")
+        arch.add_subnetwork(0, "linear")
+        arch.add_subnetwork(1, "dnn")
+        arch.add_replay_index(2)
+        restored = Architecture.deserialize(arch.serialize(global_step=7))
+        assert restored.ensemble_candidate_name == "cand"
+        assert restored.ensembler_name == "complexity_regularized"
+        assert restored.global_step == 7
+        assert restored.subnetworks == ((0, "linear"), (1, "dnn"))
+        assert restored.replay_indices == [2]
+
+    def test_grouped_by_iteration(self):
+        arch = Architecture("c", "e")
+        arch.add_subnetwork(0, "a")
+        arch.add_subnetwork(1, "b")
+        arch.add_subnetwork(1, "c")
+        assert arch.subnetworks_grouped_by_iteration == (
+            (0, ("a",)),
+            (1, ("b", "c")),
+        )
+
+
+class TestCandidateEma:
+    def test_zero_debiased_first_update_equals_value(self):
+        state = initial_candidate_state()
+        state = update_candidate_state(state, 2.0, decay=0.9)
+        np.testing.assert_allclose(float(debiased_ema(state, 0.9)), 2.0, rtol=1e-6)
+
+    def test_converges_to_constant(self):
+        state = initial_candidate_state()
+        for _ in range(200):
+            state = update_candidate_state(state, 1.5, decay=0.9)
+        np.testing.assert_allclose(
+            float(debiased_ema(state, 0.9)), 1.5, rtol=1e-5
+        )
+
+    def test_nan_quarantine_is_permanent(self):
+        state = initial_candidate_state()
+        state = update_candidate_state(state, 1.0, decay=0.9)
+        state = update_candidate_state(state, float("nan"), decay=0.9)
+        assert bool(state.dead)
+        state = update_candidate_state(state, 0.5, decay=0.9)
+        assert bool(state.dead)
+        assert float(debiased_ema(state, 0.9)) == float("inf")
+
+
+class TestReportAccessor:
+    def test_write_read_round_trip(self, tmp_path):
+        accessor = ReportAccessor(str(tmp_path))
+        reports = [
+            MaterializedReport(
+                iteration_number=0,
+                name="dnn",
+                hparams={"depth": 2},
+                metrics={"loss": 0.5},
+                included_in_final_ensemble=True,
+            )
+        ]
+        accessor.write_iteration_report(0, reports)
+        accessor.write_iteration_report(1, [])
+        out = accessor.read_iteration_reports()
+        assert len(out) == 2
+        assert out[0][0].name == "dnn"
+        assert out[0][0].hparams == {"depth": 2}
+        assert out[0][0].included_in_final_ensemble
+
+    def test_rewrite_iteration_is_idempotent(self, tmp_path):
+        accessor = ReportAccessor(str(tmp_path))
+        r = MaterializedReport(iteration_number=0, name="a")
+        accessor.write_iteration_report(0, [r])
+        accessor.write_iteration_report(0, [r])
+        assert len(accessor.read_iteration_reports()) == 1
+
+
+class TestEvaluatorObjective:
+    def test_objective_fns(self):
+        assert Evaluator(input_fn=None).objective_fn is np.nanargmin
+        maximize = Evaluator(
+            input_fn=None, metric_name="accuracy", objective="maximize"
+        )
+        assert maximize.objective_fn is np.nanargmax
+        assert maximize.metric_name == "accuracy"
+
+
+class TestReplayConfig:
+    def test_indices(self):
+        config = replay.Config(best_ensemble_indices=[1, 0])
+        assert config.get_best_ensemble_index(0) == 1
+        assert config.get_best_ensemble_index(1) == 0
+        assert config.get_best_ensemble_index(2) is None
+
+
+class TestCheckpoint:
+    def test_manifest_round_trip(self, tmp_path):
+        info = ckpt_lib.CheckpointInfo(
+            iteration_number=3,
+            global_step=42,
+            iteration_state_file="ckpt-42.msgpack",
+            replay_indices=[0, 1, 0],
+        )
+        ckpt_lib.write_manifest(str(tmp_path), info)
+        restored = ckpt_lib.read_manifest(str(tmp_path))
+        assert restored.iteration_number == 3
+        assert restored.global_step == 42
+        assert restored.iteration_state_file == "ckpt-42.msgpack"
+        assert restored.replay_indices == [0, 1, 0]
+
+    def test_payload_round_trip_preserves_lists(self, tmp_path):
+        payload = {
+            "members": [
+                {"params": {"w": np.arange(4.0)}, "complexity": 1.5},
+                {"params": {"w": np.ones((2, 2))}, "complexity": 2.0},
+            ],
+            "name": "t0_x",
+        }
+        ckpt_lib.save_payload(str(tmp_path), "p.msgpack", payload)
+        restored = ckpt_lib.restore_payload(str(tmp_path), "p.msgpack")
+        assert isinstance(restored["members"], list)
+        np.testing.assert_array_equal(
+            restored["members"][1]["params"]["w"], np.ones((2, 2))
+        )
+        assert restored["members"][0]["complexity"] == 1.5
+
+    def test_pytree_round_trip_with_target(self, tmp_path):
+        import optax
+
+        params = {"dense": {"kernel": jnp.ones((3, 2))}}
+        opt_state = optax.adam(1e-3).init(params)
+        ckpt_lib.save_pytree(
+            str(tmp_path), "s.msgpack", {"p": params, "o": opt_state}
+        )
+        target = {
+            "p": {"dense": {"kernel": jnp.zeros((3, 2))}},
+            "o": optax.adam(1e-3).init(
+                {"dense": {"kernel": jnp.zeros((3, 2))}}
+            ),
+        }
+        restored = ckpt_lib.restore_pytree(str(tmp_path), "s.msgpack", target)
+        np.testing.assert_array_equal(
+            restored["p"]["dense"]["kernel"], np.ones((3, 2))
+        )
+
+
+class TestCountDownTimer:
+    def test_counts_down(self):
+        timer = CountDownTimer(10.0)
+        assert 9.0 < timer.secs_remaining() <= 10.0
+        timer = CountDownTimer(0.0)
+        assert timer.secs_remaining() == 0.0
+
+
+def test_estimator_debug_mode_rejects_nan_inputs(tmp_path):
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder
+
+    def nan_input_fn():
+        x = np.ones((8, 2), np.float32)
+        x[3, 1] = np.nan
+        yield {"x": x}, np.ones((8, 1), np.float32)
+
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator([DNNBuilder("dnn", 1)]),
+        max_iteration_steps=4,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        max_iterations=1,
+        model_dir=str(tmp_path / "m"),
+        log_every_steps=0,
+        debug=True,
+    )
+    with pytest.raises(FloatingPointError):
+        est.train(nan_input_fn, max_steps=4)
+
+
+def test_evaluate_all_candidates(tmp_path):
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder, linear_dataset
+
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+        ),
+        max_iteration_steps=8,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        max_iterations=1,
+        model_dir=str(tmp_path / "m"),
+        log_every_steps=0,
+    )
+    # Stop mid-iteration so all candidates are live.
+    est.train(linear_dataset(), max_steps=5)
+    results = est.evaluate_all_candidates(linear_dataset(), steps=2)
+    assert set(results) == {
+        "t0_a_grow_complexity_regularized",
+        "t0_b_grow_complexity_regularized",
+    }
+    for metrics in results.values():
+        assert np.isfinite(metrics["adanet_loss"])

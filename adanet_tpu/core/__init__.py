@@ -7,6 +7,7 @@ TPU-native analogue of the reference `adanet.core` package
 from adanet_tpu.core.architecture import Architecture
 from adanet_tpu.core.estimator import Estimator
 from adanet_tpu.core.evaluator import Evaluator
+from adanet_tpu.core.export import load_serving_program
 from adanet_tpu.core.evaluator import Objective
 from adanet_tpu.core.frozen import FrozenEnsemble
 from adanet_tpu.core.frozen import FrozenSubnetwork
@@ -40,6 +41,7 @@ __all__ = [
     "Objective",
     "RegressionHead",
     "EventFileWriter",
+    "load_serving_program",
     "ReportAccessor",
     "ReportMaterializer",
     "ScopedSummary",

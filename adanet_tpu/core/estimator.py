@@ -45,6 +45,8 @@ from adanet_tpu.core.report_accessor import ReportAccessor
 from adanet_tpu.core.report_materializer import ReportMaterializer
 from adanet_tpu.core.summary import ScopedSummary
 from adanet_tpu.distributed import coordination
+from adanet_tpu.distributed.executor import RoundRobinExecutor
+from adanet_tpu.distributed.placement import RoundRobinStrategy
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
 
@@ -129,6 +131,7 @@ class Estimator:
         profile_dir: Optional[str] = None,
         profile_steps: int = 5,
         debug: bool = False,
+        placement_strategy=None,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -171,6 +174,12 @@ class Estimator:
         # reaches the device, the analogue of the reference's debug-mode
         # feature/label NaN asserts (reference: estimator.py:386-439).
         self._debug = bool(debug)
+        # Training placement: a RoundRobinStrategy trains candidates on
+        # disjoint submeshes; bookkeeping/evaluate/export always run
+        # replicated, exactly as the reference forces ReplicationStrategy
+        # outside training (reference: estimator.py:1081-1118 and SURVEY
+        # §1 L5). None = replicated training (the reference default).
+        self._placement_strategy = placement_strategy
 
         self._iteration_builder = IterationBuilder(
             head=head,
@@ -238,7 +247,21 @@ class Estimator:
             iteration = self._build_iteration(
                 t, sample_batch, cached_previous=cached_previous
             )
+            executor = None
+            if isinstance(self._placement_strategy, RoundRobinStrategy):
+                executor = RoundRobinExecutor(
+                    iteration, self._placement_strategy
+                )
+                if self._iterations_per_loop > 1:
+                    _LOG.warning(
+                        "iterations_per_loop=%d is ignored under "
+                        "RoundRobinStrategy placement (one step per "
+                        "dispatch).",
+                        self._iterations_per_loop,
+                    )
             state = self._init_or_restore_state(iteration, sample_batch, info)
+            if executor is not None:
+                state = executor.place(state)
 
             # Candidates with dedicated training data (bagging; reference:
             # adanet/autoensemble/common.py:59-93) get their own iterators.
@@ -248,6 +271,12 @@ class Estimator:
                 if getattr(spec.builder, "train_input_fn", None) is not None
             }
             extra_iters: Dict[str, Iterator] = {}
+            if executor is not None and extra_input_fns:
+                raise ValueError(
+                    "Per-candidate train_input_fn (bagging) is not "
+                    "supported with RoundRobinStrategy placement; use the "
+                    "default replicated placement."
+                )
 
             steps_done = int(jax.device_get(state.iteration_step))
             _LOG.info(
@@ -286,7 +315,15 @@ class Estimator:
                     )
                 loop_size = min(self._iterations_per_loop, steps_budget)
                 prev_steps_done = steps_done
-                if loop_size > 1 and not extra_input_fns:
+                if executor is not None:
+                    # Candidate-parallel training: one step per dispatch
+                    # (iterations_per_loop does not apply here; bagging is
+                    # rejected above).
+                    batch, data_iter = self._next_batch(input_fn, data_iter)
+                    state, metrics = executor.train_step(state, batch)
+                    steps_done += 1
+                    info.global_step += 1
+                elif loop_size > 1 and not extra_input_fns:
                     batches = []
                     for _ in range(loop_size):
                         batch, data_iter = self._next_batch(
@@ -359,6 +396,11 @@ class Estimator:
             if profiling:
                 jax.profiler.stop_trace()
                 profiling = False
+
+            if executor is not None:
+                # Bookkeeping (selection/eval/freeze) runs replicated, as
+                # the reference forces ReplicationStrategy outside training.
+                state = executor.gather(state)
 
             if steps_done < self._max_iteration_steps:
                 # Interrupted by max_steps: persist mid-iteration and stop.
@@ -694,6 +736,7 @@ class Estimator:
             # Scopes are per-iteration (t<N>_...); close them so open file
             # handles stay bounded across long searches.
             self._summary.close()
+        return frozen
 
     # ------------------------------------------------------- evaluate/predict
 
@@ -862,14 +905,17 @@ class Estimator:
 
     # ---------------------------------------------------------------- export
 
-    def export_saved_model(self, export_dir: str, sample_batch) -> str:
-        """Exports the final frozen ensemble's durable state.
+    def export_saved_model(
+        self, export_dir: str, sample_batch, serialize_program: bool = True
+    ) -> str:
+        """Exports the final frozen ensemble for serving.
 
-        Writes the architecture JSON + numeric payload; reload with an
-        `Estimator` constructed with the same deterministic generator and
-        `restore_export`. (The reference exports a TF SavedModel,
-        estimator.py:1081-1118; the JAX-native equivalent of a hermetic
-        serialized program via `jax.export` is planned.)
+        Writes (a) the durable state — architecture JSON + numeric
+        payload, reloadable with the same deterministic generator — and
+        (b) when `serialize_program`, a hermetic StableHLO program of the
+        full prediction function with parameters baked in
+        (`core/export.py`), loadable with no model code: the analogue of
+        the reference's SavedModel export (estimator.py:1081-1118).
         """
         info = ckpt_lib.read_manifest(self._model_dir)
         if info is None or info.iteration_number == 0:
@@ -884,4 +930,23 @@ class Estimator:
         payload["name"] = frozen.name
         payload["iteration_number"] = frozen.iteration_number
         ckpt_lib.save_payload(export_dir, "ensemble.msgpack", payload)
+
+        if serialize_program:
+            from adanet_tpu.core import export as export_lib
+
+            ensembler = self._iteration_builder._ensembler_by_name(
+                frozen.ensembler_name
+            )
+
+            def predict_fn(features):
+                outs = frozen.member_outputs(features, training=False)
+                ensemble = ensembler.build_ensemble(
+                    frozen.ensembler_params, outs
+                )
+                return self._head.predictions(ensemble.logits)
+
+            features, _ = sample_batch
+            export_lib.export_serving_program(
+                export_dir, predict_fn, features
+            )
         return export_dir

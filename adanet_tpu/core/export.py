@@ -1,0 +1,113 @@
+"""Serving export: hermetic serialized ensembles.
+
+The reference exports TF SavedModels for serving
+(reference: adanet/core/estimator.py:1081-1118, export paths tested at
+estimator_test.py:2223-2416). The JAX-native equivalent has two layers:
+
+1. the durable payload (architecture JSON + numeric msgpack) written by
+   `Estimator.export_saved_model`, reloadable with the same deterministic
+   generator; and
+2. this module's **serialized program**: the best ensemble's full
+   prediction function (member forwards + mixture combine + head
+   predictions) lowered to StableHLO via `jax.export` with the parameters
+   baked in — loadable and runnable with *no* framework, generator, or
+   model code, like a SavedModel.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Callable, Dict
+
+import jax
+import numpy as np
+
+_LOG = logging.getLogger("adanet_tpu")
+
+SERVING_FILE = "serving.stablehlo"
+SIGNATURE_FILE = "serving_signature.json"
+
+
+def export_serving_program(
+    export_dir: str,
+    predict_fn: Callable,
+    sample_features: Any,
+    polymorphic_batch: bool = True,
+) -> str:
+    """Serializes `predict_fn(features) -> predictions` with params baked in.
+
+    With `polymorphic_batch` (default) the leading dimension is exported as
+    a symbolic size so the served program accepts any batch size, like a
+    SavedModel; models whose lowering requires a concrete batch fall back
+    to the sample batch's size (recorded in the signature). The artifact
+    targets the current backend platform (`jax.export` records it; serve on
+    the same platform family).
+    """
+
+    def arg_shapes(batch_dim):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                (batch_dim,) + np.asarray(x).shape[1:], np.asarray(x).dtype
+            ),
+            sample_features,
+        )
+
+    exported = None
+    if polymorphic_batch:
+        try:
+            (batch_sym,) = jax.export.symbolic_shape("batch")
+            exported = jax.export.export(jax.jit(predict_fn))(
+                arg_shapes(batch_sym)
+            )
+        except Exception as e:  # shape-specialized models fall back
+            _LOG.info(
+                "Polymorphic-batch export failed (%s); pinning the sample "
+                "batch size.",
+                e,
+            )
+    if exported is None:
+        exported = jax.export.export(jax.jit(predict_fn))(
+            arg_shapes(np.asarray(jax.tree_util.tree_leaves(sample_features)[0]).shape[0])
+        )
+
+    os.makedirs(export_dir, exist_ok=True)
+    path = os.path.join(export_dir, SERVING_FILE)
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+    out_shapes = jax.tree_util.tree_unflatten(
+        exported.out_tree, list(exported.out_avals)
+    )
+    signature = {
+        "platforms": list(exported.platforms),
+        "inputs": jax.tree_util.tree_map(
+            lambda s: {"shape": [str(d) for d in s.shape], "dtype": str(s.dtype)},
+            # in_tree wraps ((args,), kwargs); expose the features arg.
+            jax.tree_util.tree_unflatten(
+                exported.in_tree, list(exported.in_avals)
+            )[0][0],
+        ),
+        "outputs": jax.tree_util.tree_map(
+            lambda s: {"shape": [str(d) for d in s.shape], "dtype": str(s.dtype)},
+            out_shapes,
+        ),
+    }
+    with open(os.path.join(export_dir, SIGNATURE_FILE), "w") as f:
+        json.dump(signature, f, indent=2, sort_keys=True)
+    return path
+
+
+def load_serving_program(export_dir: str) -> Callable:
+    """Loads a serialized ensemble; returns `fn(features) -> predictions`.
+
+    Needs only jax — no generator, builders, or model code.
+    """
+    with open(os.path.join(export_dir, SERVING_FILE), "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    return exported.call
+
+
+def serving_signature(export_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(export_dir, SIGNATURE_FILE)) as f:
+        return json.load(f)

@@ -118,9 +118,9 @@ class RoundRobinExecutor:
     def init_state(self, rng, sample_batch) -> IterationState:
         """Initializes and places state pieces onto their submeshes."""
         state = self.iteration.init_state(rng, sample_batch)
-        return self._place(state)
+        return self.place(state)
 
-    def _place(self, state: IterationState) -> IterationState:
+    def place(self, state: IterationState) -> IterationState:
         sub_states = {
             name: mesh_lib.replicate_state(
                 st, self._sub_meshes[name]

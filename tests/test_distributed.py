@@ -186,6 +186,29 @@ def test_multi_process_chief_worker(tmp_path):
     assert b"ROLE 1 DONE" in worker_out
 
 
+def test_estimator_with_round_robin_placement(tmp_path):
+    """Full Estimator lifecycle with candidate-parallel training placement."""
+    import adanet_tpu
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    est = adanet_tpu.Estimator(
+        head=RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+        ),
+        max_iteration_steps=6,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        max_iterations=2,
+        model_dir=str(tmp_path / "model"),
+        log_every_steps=0,
+        placement_strategy=RoundRobinStrategy(),
+    )
+    est.train(linear_dataset(), max_steps=100)
+    assert est.latest_iteration_number() == 2
+    metrics = est.evaluate(linear_dataset())
+    assert np.isfinite(metrics["average_loss"])
+
+
 def test_round_robin_executor_stale_sync():
     """sync_every > 1 (async-PS analogue) still trains and selects."""
     factory = IterationBuilder(

@@ -12,9 +12,8 @@ complexity 0 (reference hardcodes 0, common.py:188).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional
 
-import jax.numpy as jnp
 import optax
 
 from adanet_tpu.subnetwork import Builder, Generator, Subnetwork

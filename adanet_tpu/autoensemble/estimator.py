@@ -10,8 +10,6 @@ TPU code path.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
-
 from adanet_tpu.autoensemble.common import _GeneratorFromCandidatePool
 from adanet_tpu.core.estimator import Estimator
 

@@ -11,7 +11,7 @@ selection phase and used to rebuild frozen iterations deterministically.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class Architecture:

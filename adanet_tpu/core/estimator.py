@@ -27,7 +27,7 @@ import itertools
 import logging
 import os
 import tempfile
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
@@ -741,7 +741,13 @@ class Estimator:
     # ------------------------------------------------------- evaluate/predict
 
     def _final_forward_fn(self, sample_batch):
-        """Returns (forward_fn, name): jitted best-model forward pass."""
+        """Returns (forward, params, name) for the best model.
+
+        `forward(params, features) -> Ensemble` is a pure function;
+        callers jit it with `params` as an argument so the weights stay
+        device buffers instead of being baked into compiled programs as
+        literals.
+        """
         info = ckpt_lib.read_manifest(self._model_dir)
         if info is None:
             raise ValueError(
@@ -757,10 +763,10 @@ class Estimator:
             best = self._get_best_ensemble_index(iteration, state)
             name = iteration.ensemble_specs[best].name
 
-            def forward(features):
-                return iteration.ensemble_forward(state, name, features)
+            def forward(s, features):
+                return iteration.ensemble_forward(s, name, features)
 
-            return jax.jit(forward), name
+            return forward, state, name
         # Otherwise: the frozen winner of the last completed iteration.
         frozen = self._rebuild_previous_ensemble(
             info.iteration_number, sample_batch
@@ -770,14 +776,20 @@ class Estimator:
         ensembler = self._iteration_builder._ensembler_by_name(
             frozen.ensembler_name
         )
+        params = {
+            "members": [
+                ws.subnetwork.params for ws in frozen.weighted_subnetworks
+            ],
+            "ensembler": frozen.ensembler_params,
+        }
 
-        def forward(features):
-            outs = frozen.member_outputs(features, training=False)
-            return ensembler.build_ensemble(
-                frozen.ensembler_params, outs
+        def forward(p, features):
+            outs = frozen.member_outputs(
+                features, training=False, params=p["members"]
             )
+            return ensembler.build_ensemble(p["ensembler"], outs)
 
-        return jax.jit(forward), frozen.name
+        return forward, params, frozen.name
 
     def _bootstrap_input(self, input_fn):
         """First batch + re-chained iterator (errors on empty input)."""
@@ -819,11 +831,11 @@ class Estimator:
     ) -> Dict[str, float]:
         """Evaluates the best ensemble; returns averaged metrics."""
         first, data = self._bootstrap_input(input_fn)
-        forward, name = self._final_forward_fn(first)
+        forward, params, name = self._final_forward_fn(first)
 
         @jax.jit
-        def metrics_fn(features, labels):
-            ensemble = forward(features)
+        def metrics_fn(params, features, labels):
+            ensemble = forward(params, features)
             out = dict(self._head.eval_metrics(ensemble.logits, labels))
             out["loss"] = self._head.loss(ensemble.logits, labels)
             if self._metric_fn is not None:
@@ -833,7 +845,7 @@ class Estimator:
         totals: Dict[str, float] = {}
         count = 0
         for features, labels in self._eval_batches(data, steps):
-            host = jax.device_get(metrics_fn(features, labels))
+            host = jax.device_get(metrics_fn(params, features, labels))
             for key, value in host.items():
                 totals[key] = totals.get(key, 0.0) + float(value)
             count += 1
@@ -892,16 +904,16 @@ class Estimator:
             return
         data = itertools.chain([first], data)
         features0 = first[0] if isinstance(first, tuple) else first
-        forward, _ = self._final_forward_fn((features0, None))
+        forward, params, _ = self._final_forward_fn((features0, None))
 
         @jax.jit
-        def predict_fn(features):
-            ensemble = forward(features)
+        def predict_fn(params, features):
+            ensemble = forward(params, features)
             return self._head.predictions(ensemble.logits)
 
         for batch in self._eval_batches(data, None):
             features = batch[0] if isinstance(batch, tuple) else batch
-            yield jax.device_get(predict_fn(features))
+            yield jax.device_get(predict_fn(params, features))
 
     # ---------------------------------------------------------------- export
 

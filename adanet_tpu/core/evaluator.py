@@ -10,7 +10,7 @@ the best index by the configured objective.
 from __future__ import annotations
 
 import enum
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional
 
 import jax
 import numpy as np

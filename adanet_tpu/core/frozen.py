@@ -96,9 +96,19 @@ class FrozenEnsemble:
             return self.ensembler_params.get("bias")
         return None
 
-    def member_outputs(self, features, training: bool = False):
-        """Forward passes of every frozen member on `features` (inside jit)."""
+    def member_outputs(self, features, training: bool = False, params=None):
+        """Forward passes of every frozen member on `features` (inside jit).
+
+        `params` optionally overrides each member's stored parameters (a
+        list aligned with `weighted_subnetworks`) — used when parameters
+        are threaded through jit as arguments rather than closed over.
+        """
+        if params is None:
+            return [
+                ws.subnetwork.apply(features, training=training)
+                for ws in self.weighted_subnetworks
+            ]
         return [
-            ws.subnetwork.apply(features, training=training)
-            for ws in self.weighted_subnetworks
+            ws.subnetwork.module.apply(p, features, training=training)
+            for ws, p in zip(self.weighted_subnetworks, params)
         ]

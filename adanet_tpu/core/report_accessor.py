@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Dict, Iterable, List, Sequence
+from typing import Dict, List, Sequence
 
 from adanet_tpu.subnetwork.report import MaterializedReport
 

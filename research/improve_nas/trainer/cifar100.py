@@ -8,8 +8,6 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
-
 from research.improve_nas.trainer import cifar10
 
 

@@ -16,7 +16,6 @@ Example (fake data smoke run):
 from __future__ import annotations
 
 import json
-import os
 
 from absl import app, flags, logging
 

@@ -7,7 +7,6 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 import adanet_tpu
 from adanet_tpu import AutoEnsembleEstimator, AutoEnsembleSubestimator

@@ -4,10 +4,6 @@ Coverage analogue of the reference's unit suites: architecture_test.py,
 report_accessor_test.py, evaluator_test.py, candidate_test.py, timer_test.py.
 """
 
-import json
-import time
-
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -19,7 +15,7 @@ from adanet_tpu.core.candidate import (
     initial_candidate_state,
     update_candidate_state,
 )
-from adanet_tpu.core.evaluator import Evaluator, Objective
+from adanet_tpu.core.evaluator import Evaluator
 from adanet_tpu.core.report_accessor import ReportAccessor
 from adanet_tpu.core.timer import CountDownTimer
 from adanet_tpu.subnetwork import MaterializedReport

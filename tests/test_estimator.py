@@ -6,24 +6,18 @@ train→evaluate→predict→export lifecycles, checkpoint/resume, replay,
 force_grow, evaluator-based selection, and report round-trips.
 """
 
-import glob
 import json
 import os
 
 import numpy as np
 import optax
-import pytest
 
 import adanet_tpu
 from adanet_tpu import replay
 from adanet_tpu.core.estimator import Estimator
-from adanet_tpu.core.evaluator import Evaluator, Objective
+from adanet_tpu.core.evaluator import Evaluator
 from adanet_tpu.core.report_materializer import ReportMaterializer
-from adanet_tpu.ensemble import (
-    ComplexityRegularizedEnsembler,
-    GrowStrategy,
-    SoloStrategy,
-)
+from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
 from adanet_tpu.subnetwork import SimpleGenerator
 
 from helpers import DNNBuilder, linear_dataset
@@ -259,3 +253,76 @@ def test_export_serving_program_round_trip(tmp_path):
     # Polymorphic batch: the served program accepts other batch sizes.
     out3 = served({"x": np.ones((3, 2), np.float32)})
     assert out3["predictions"].shape == (3, 1)
+
+
+def test_multi_head_lifecycle(tmp_path):
+    """Dict logits/labels through the full lifecycle
+    (reference: estimator_test.py:1517 multi-head coverage)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from adanet_tpu.subnetwork import Builder, Subnetwork
+
+    head = adanet_tpu.MultiHead(
+        [
+            adanet_tpu.RegressionHead(name="reg"),
+            adanet_tpu.MultiClassHead(3, name="cls"),
+        ]
+    )
+
+    class _TwoHeadModule(nn.Module):
+        dims: dict
+
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            x = jnp.asarray(features["x"], jnp.float32)
+            h = nn.relu(nn.Dense(8)(x))
+            logits = {
+                key: nn.Dense(dim, name="logits_%s" % key)(h)
+                for key, dim in sorted(self.dims.items())
+            }
+            return Subnetwork(
+                last_layer={key: h for key in self.dims},
+                logits=logits,
+                complexity=1.0,
+            )
+
+    class _TwoHeadBuilder(Builder):
+        @property
+        def name(self):
+            return "two_head"
+
+        def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+            return _TwoHeadModule(dims=logits_dimension)
+
+        def build_train_optimizer(self, previous_ensemble=None):
+            return optax.sgd(0.05)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    labels = {
+        "reg": x.sum(axis=1, keepdims=True).astype(np.float32),
+        "cls": rng.randint(0, 3, size=(64,)),
+    }
+
+    def input_fn():
+        for s in range(0, 64, 16):
+            yield (
+                {"x": x[s : s + 16]},
+                {k: v[s : s + 16] for k, v in labels.items()},
+            )
+
+    est = _make_estimator(
+        tmp_path,
+        head=head,
+        subnetwork_generator=SimpleGenerator([_TwoHeadBuilder()]),
+        max_iterations=2,
+    )
+    est.train(input_fn, max_steps=100)
+    assert est.latest_iteration_number() == 2
+    metrics = est.evaluate(input_fn)
+    assert np.isfinite(metrics["average_loss"])
+    assert "cls/accuracy" in metrics
+    preds = next(iter(est.predict(input_fn)))
+    assert preds["reg/predictions"].shape == (16, 1)
+    assert preds["cls/class_ids"].shape == (16,)

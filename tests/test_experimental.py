@@ -5,7 +5,6 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from adanet_tpu.experimental import (
     AllStrategy,

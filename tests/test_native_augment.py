@@ -3,7 +3,6 @@
 import time
 
 import numpy as np
-import pytest
 
 from adanet_tpu.ops import native_augment
 from research.improve_nas.trainer import image_processing

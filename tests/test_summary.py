@@ -8,8 +8,6 @@ hand-rolled tfevents encoding against the real TensorBoard reader.
 import glob
 import os
 
-import numpy as np
-import pytest
 
 from adanet_tpu.core.summary import EventFileWriter, ScopedSummary
 

@@ -11,7 +11,6 @@ import os
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 import adanet_tpu
 from adanet_tpu import TPUEstimator

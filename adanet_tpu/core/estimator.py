@@ -174,6 +174,7 @@ class Estimator:
         # reaches the device, the analogue of the reference's debug-mode
         # feature/label NaN asserts (reference: estimator.py:386-439).
         self._debug = bool(debug)
+        self._iteration_cache: Optional[Iteration] = None
         # Training placement: a RoundRobinStrategy trains candidates on
         # disjoint submeshes; bookkeeping/evaluate/export always run
         # replicated, exactly as the reference forces ReplicationStrategy
@@ -538,6 +539,14 @@ class Estimator:
     def _build_iteration(
         self, iteration_number, sample_batch, cached_previous=None
     ) -> Iteration:
+        # Iteration structure is deterministic per t (generators must be
+        # deterministic), so rebuilding the same iteration in-process —
+        # e.g. evaluate()/predict() right after train() — reuses the
+        # already-jitted instance instead of recompiling (SURVEY §7 hard
+        # part (a): compiled-step caching).
+        cached = self._iteration_cache
+        if cached is not None and cached.iteration_number == iteration_number:
+            return cached
         if (
             cached_previous is not None
             and cached_previous.iteration_number == iteration_number - 1
@@ -548,9 +557,11 @@ class Estimator:
                 iteration_number, sample_batch
             )
         builders = self._generate_builders(iteration_number, previous)
-        return self._iteration_builder.build_iteration(
+        iteration = self._iteration_builder.build_iteration(
             iteration_number, builders, previous
         )
+        self._iteration_cache = iteration
+        return iteration
 
     def _rebuild_previous_ensemble(
         self, iteration_number: int, sample_batch
@@ -736,6 +747,10 @@ class Estimator:
             # Scopes are per-iteration (t<N>_...); close them so open file
             # handles stay bounded across long searches.
             self._summary.close()
+        # The completed iteration's compiled programs and frozen device
+        # buffers can never be reused; drop them so accelerator memory is
+        # released.
+        self._iteration_cache = None
         return frozen
 
     # ------------------------------------------------------- evaluate/predict

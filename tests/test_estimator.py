@@ -326,3 +326,55 @@ def test_multi_head_lifecycle(tmp_path):
     preds = next(iter(est.predict(input_fn)))
     assert preds["reg/predictions"].shape == (16, 1)
     assert preds["cls/class_ids"].shape == (16,)
+
+
+def test_multiple_strategies_and_ensemblers_lifecycle(tmp_path):
+    """Solo+Grow+All strategies x CRE+Mean ensemblers through the full
+    search (the reference's candidates-per-iteration cross product,
+    iteration.py:683-740)."""
+    from adanet_tpu.ensemble import (
+        AllStrategy,
+        GrowStrategy,
+        MeanEnsembler,
+        SoloStrategy,
+    )
+
+    est = _make_estimator(
+        tmp_path,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(
+                optimizer=optax.sgd(0.05), adanet_lambda=0.01
+            ),
+            MeanEnsembler(),
+        ],
+        ensemble_strategies=[
+            GrowStrategy(),
+            SoloStrategy(),
+            AllStrategy(),
+        ],
+        max_iterations=2,
+        max_iteration_steps=6,
+    )
+    est.train(linear_dataset(), max_steps=100)
+    assert est.latest_iteration_number() == 2
+    metrics = est.evaluate(linear_dataset())
+    assert np.isfinite(metrics["average_loss"])
+    # 2 builders x 3 strategies -> grow(2) + solo(2) + all(1) = 5 candidate
+    # groups x 2 ensemblers = 10 candidates at t=0.
+    it0 = est._build_iteration(0, next(linear_dataset()()))
+    assert len(it0.candidate_names()) == 10
+    arch = json.load(open(os.path.join(est.model_dir, "architecture-0.json")))
+    assert arch["ensembler_name"] in ("complexity_regularized", "mean")
+
+
+def test_iteration_cache_reuses_compiled_iteration(tmp_path):
+    """Mid-iteration rebuilds reuse the jitted Iteration; completing the
+    iteration drops it (releasing compiled programs and buffers)."""
+    est = _make_estimator(tmp_path, max_iterations=1)
+    est.train(linear_dataset(), max_steps=5)  # mid-iteration
+    sample = next(linear_dataset()())
+    it1 = est._build_iteration(0, sample)
+    it2 = est._build_iteration(0, sample)
+    assert it1 is it2
+    est.train(linear_dataset(), max_steps=100)  # completes the search
+    assert est._iteration_cache is None

@@ -132,6 +132,8 @@ class Estimator:
         profile_steps: int = 5,
         debug: bool = False,
         placement_strategy=None,
+        export_subnetwork_logits: bool = False,
+        export_subnetwork_last_layer: bool = False,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -175,6 +177,13 @@ class Estimator:
         # feature/label NaN asserts (reference: estimator.py:386-439).
         self._debug = bool(debug)
         self._iteration_cache: Optional[Iteration] = None
+        # Include per-member outputs in predictions (reference ctor flags
+        # export_subnetwork_logits/export_subnetwork_last_layer,
+        # estimator.py:604-759).
+        self._export_subnetwork_logits = bool(export_subnetwork_logits)
+        self._export_subnetwork_last_layer = bool(
+            export_subnetwork_last_layer
+        )
         # Training placement: a RoundRobinStrategy trains candidates on
         # disjoint submeshes; bookkeeping/evaluate/export always run
         # replicated, exactly as the reference forces ReplicationStrategy
@@ -870,6 +879,19 @@ class Estimator:
         result["global_step"] = self.latest_global_step()
         return result
 
+    def _predictions_with_member_outputs(self, ensemble):
+        """Head predictions plus per-member outputs when the
+        export_subnetwork_* flags are set (shared by predict and the
+        serialized serving program)."""
+        out = self._head.predictions(ensemble.logits)
+        members = getattr(ensemble, "subnetworks", None) or []
+        for i, member in enumerate(members):
+            if self._export_subnetwork_logits:
+                out["subnetwork_logits/%d" % i] = member.logits
+            if self._export_subnetwork_last_layer:
+                out["subnetwork_last_layer/%d" % i] = member.last_layer
+        return out
+
     def evaluate_all_candidates(
         self,
         input_fn: Callable[[], Iterator],
@@ -924,7 +946,7 @@ class Estimator:
         @jax.jit
         def predict_fn(params, features):
             ensemble = forward(params, features)
-            return self._head.predictions(ensemble.logits)
+            return self._predictions_with_member_outputs(ensemble)
 
         for batch in self._eval_batches(data, None):
             features = batch[0] if isinstance(batch, tuple) else batch
@@ -970,7 +992,7 @@ class Estimator:
                 ensemble = ensembler.build_ensemble(
                     frozen.ensembler_params, outs
                 )
-                return self._head.predictions(ensemble.logits)
+                return self._predictions_with_member_outputs(ensemble)
 
             features, _ = sample_batch
             export_lib.export_serving_program(

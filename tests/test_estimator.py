@@ -378,3 +378,20 @@ def test_iteration_cache_reuses_compiled_iteration(tmp_path):
     assert it1 is it2
     est.train(linear_dataset(), max_steps=100)  # completes the search
     assert est._iteration_cache is None
+
+
+def test_export_subnetwork_outputs_in_predict(tmp_path):
+    """Per-member logits/last layers in predictions
+    (reference ctor flags export_subnetwork_logits/last_layer)."""
+    est = _make_estimator(
+        tmp_path,
+        max_iterations=2,
+        export_subnetwork_logits=True,
+        export_subnetwork_last_layer=True,
+    )
+    est.train(linear_dataset(), max_steps=100)
+    preds = next(iter(est.predict(linear_dataset())))
+    assert "subnetwork_logits/0" in preds
+    assert "subnetwork_logits/1" in preds  # 2 members after 2 iterations
+    assert preds["subnetwork_logits/0"].shape == (16, 1)
+    assert preds["subnetwork_last_layer/0"].shape[0] == 16

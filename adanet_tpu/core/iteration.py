@@ -348,6 +348,28 @@ class Iteration:
             return out, mutated
         return spec.module.apply(variables, features, training=False), None
 
+    def build_loss_context(self, prev_ensembler_params, frozen_outs):
+        """Distillation teacher signals from the frozen previous ensemble.
+
+        Shared by the fused single-program path and the RoundRobin
+        executor so teachers are defined in exactly one place. Returns
+        None when there is no previous ensemble.
+        """
+        if not frozen_outs or self.previous_ensemble is None:
+            return None
+        prev_spec = self.ensemble_specs[0]
+        prev_ensemble = prev_spec.ensembler.build_ensemble(
+            prev_ensembler_params, frozen_outs
+        )
+        return TrainLossContext(
+            previous_ensemble_logits=jax.lax.stop_gradient(
+                prev_ensemble.logits
+            ),
+            previous_subnetwork_logits=jax.lax.stop_gradient(
+                frozen_outs[-1].logits
+            ),
+        )
+
     def frozen_outputs(self, frozen_params, features):
         """Forward passes of the frozen members (callable inside jit)."""
         return [
@@ -461,17 +483,9 @@ class Iteration:
                 if shared_frozen_outs is not None
                 else self.frozen_outputs(state.frozen, batch_features)
             )
-            prev_spec = self.ensemble_specs[0]
-            prev_ensemble = prev_spec.ensembler.build_ensemble(
-                state.ensembles[prev_spec.name].params, outs
-            )
-            return TrainLossContext(
-                previous_ensemble_logits=jax.lax.stop_gradient(
-                    prev_ensemble.logits
-                ),
-                previous_subnetwork_logits=jax.lax.stop_gradient(
-                    outs[-1].logits
-                ),
+            prev_name = self.ensemble_specs[0].name
+            return self.build_loss_context(
+                state.ensembles[prev_name].params, outs
             )
 
         loss_context = make_loss_context(features, frozen_outs)

@@ -64,18 +64,53 @@ class RoundRobinExecutor:
         }
         self._ens_mesh = self.strategy.ensemble_mesh(n)
 
+        # Builders with custom training losses need the distillation
+        # teacher signals; their groups hold a copy of the frozen members
+        # (the reference analogue: every worker builds the full graph,
+        # placement.py:134-194) and compute the context locally.
+        from adanet_tpu.subnetwork.generator import Builder as _BuilderBase
+
+        self._needs_context = {
+            spec.name: (
+                iteration.previous_ensemble is not None
+                and type(spec.builder).build_subnetwork_loss
+                is not _BuilderBase.build_subnetwork_loss
+            )
+            for spec in iteration.subnetwork_specs
+        }
+        self._sub_frozen = {}
+        self._sub_prev_params = {}
+
         # Per-subnetwork jitted step: forward/backward/update on its submesh.
-        def make_sub_step(spec):
-            def step(st, features, labels, rng):
+        def make_sub_step(spec, with_context):
+            if not with_context:
+
+                def step(st, features, labels, rng):
+                    new_st, _, loss = iteration.subnetwork_update(
+                        spec, st, features, labels, rng
+                    )
+                    return new_st, loss
+
+                return jax.jit(step, donate_argnums=0)
+
+            def step_with_context(
+                st, frozen_params, prev_params, features, labels, rng
+            ):
+                frozen_outs = iteration.frozen_outputs(
+                    frozen_params, features
+                )
+                context = iteration.build_loss_context(
+                    prev_params, frozen_outs
+                )
                 new_st, _, loss = iteration.subnetwork_update(
-                    spec, st, features, labels, rng
+                    spec, st, features, labels, rng, loss_context=context
                 )
                 return new_st, loss
 
-            return jax.jit(step, donate_argnums=0)
+            return jax.jit(step_with_context, donate_argnums=0)
 
         self._sub_steps = {
-            spec.name: make_sub_step(spec)
+            spec.name: make_sub_step(spec, self._needs_context[spec.name])
             for spec in iteration.subnetwork_specs
         }
 
@@ -130,6 +165,24 @@ class RoundRobinExecutor:
         ens = mesh_lib.replicate_state(state.ensembles, self._ens_mesh)
         cands = mesh_lib.replicate_state(state.candidates, self._ens_mesh)
         frozen = mesh_lib.replicate_state(state.frozen, self._ens_mesh)
+        # Teacher copies for context-needing groups (immutable during the
+        # iteration: frozen member params and the carried-over previous
+        # ensemble's params never train).
+        prev_name = (
+            self.iteration.ensemble_specs[0].name
+            if self.iteration.previous_ensemble is not None
+            else None
+        )
+        for name, needs in self._needs_context.items():
+            if not needs:
+                continue
+            mesh = self._sub_meshes[name]
+            self._sub_frozen[name] = mesh_lib.replicate_state(
+                state.frozen, mesh
+            )
+            self._sub_prev_params[name] = mesh_lib.replicate_state(
+                state.ensembles[prev_name].params, mesh
+            )
         return IterationState(
             subnetworks=sub_states,
             ensembles=ens,
@@ -158,12 +211,30 @@ class RoundRobinExecutor:
             sub_batch = mesh_lib.shard_batch(
                 (features, labels), sub_mesh
             )
-            new_st, loss = self._sub_steps[spec.name](
-                state.subnetworks[spec.name],
-                sub_batch[0],
-                sub_batch[1],
-                jax.random.fold_in(step_rng, i),
-            )
+            rng_i = jax.random.fold_in(step_rng, i)
+            if self._needs_context[spec.name]:
+                if spec.name not in self._sub_frozen:
+                    raise ValueError(
+                        "State was not placed: call executor.init_state() "
+                        "or executor.place(state) before train_step when "
+                        "builders use custom losses with a previous "
+                        "ensemble (teacher copies live per submesh)."
+                    )
+                new_st, loss = self._sub_steps[spec.name](
+                    state.subnetworks[spec.name],
+                    self._sub_frozen[spec.name],
+                    self._sub_prev_params[spec.name],
+                    sub_batch[0],
+                    sub_batch[1],
+                    rng_i,
+                )
+            else:
+                new_st, loss = self._sub_steps[spec.name](
+                    state.subnetworks[spec.name],
+                    sub_batch[0],
+                    sub_batch[1],
+                    rng_i,
+                )
             new_subnetworks[spec.name] = new_st
             metrics["subnetwork_loss/%s" % spec.name] = loss
 

@@ -225,3 +225,50 @@ def test_round_robin_executor_stale_sync():
     assert np.isfinite(
         float(metrics["adanet_loss/t0_a_grow_complexity_regularized"])
     )
+
+
+def test_round_robin_custom_loss_gets_teacher_context():
+    """A custom-loss builder under RoundRobin sees the distillation
+    teachers (previous ensemble + last frozen member logits)."""
+    import jax.numpy as jnp
+
+    seen = {"context": None}
+
+    class KDBuilder(DNNBuilder):
+        def build_subnetwork_loss(self, subnetwork, labels, head, context):
+            seen["context"] = context
+            loss = head.loss(subnetwork.logits, labels)
+            if context is not None and context.previous_ensemble_logits is not None:
+                loss = loss + 0.1 * jnp.mean(
+                    (subnetwork.logits - context.previous_ensemble_logits)
+                    ** 2
+                )
+            return loss
+
+    factory = IterationBuilder(
+        head=RegressionHead(),
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        ensemble_strategies=[GrowStrategy()],
+    )
+    sample = next(linear_dataset()())
+
+    # Iteration 0 (no teachers yet).
+    it0 = factory.build_iteration(0, [KDBuilder("a", 1)], None)
+    ex0 = RoundRobinExecutor(it0, RoundRobinStrategy())
+    st0 = ex0.init_state(jax.random.PRNGKey(0), sample)
+    st0, _ = ex0.train_step(st0, sample)
+    assert seen["context"] is None  # no previous ensemble at t=0
+    frozen = it0.freeze_candidate(
+        ex0.gather(st0), it0.candidate_names()[0], sample
+    )
+
+    # Iteration 1: the RoundRobin student must receive teacher logits.
+    it1 = factory.build_iteration(1, [KDBuilder("b", 1)], frozen)
+    ex1 = RoundRobinExecutor(it1, RoundRobinStrategy())
+    st1 = ex1.init_state(jax.random.PRNGKey(1), sample)
+    st1, metrics = ex1.train_step(st1, sample)
+    assert seen["context"] is not None
+    assert seen["context"].previous_ensemble_logits is not None
+    assert np.isfinite(
+        float(metrics["subnetwork_loss/b"])
+    )

@@ -23,6 +23,7 @@ from adanet_tpu.core.heads import BinaryClassificationHead
 from adanet_tpu.core.heads import Head
 from adanet_tpu.core.heads import MultiClassHead
 from adanet_tpu.core.heads import MultiHead
+from adanet_tpu.core.heads import MultiLabelHead
 from adanet_tpu.core.heads import RegressionHead
 from adanet_tpu.core.report_materializer import ReportMaterializer
 from adanet_tpu.subnetwork import Builder
@@ -46,6 +47,7 @@ __all__ = [
     "Head",
     "MultiClassHead",
     "MultiHead",
+    "MultiLabelHead",
     "Objective",
     "RegressionHead",
     "ReportMaterializer",

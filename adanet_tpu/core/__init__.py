@@ -16,6 +16,7 @@ from adanet_tpu.core.heads import BinaryClassificationHead
 from adanet_tpu.core.heads import Head
 from adanet_tpu.core.heads import MultiClassHead
 from adanet_tpu.core.heads import MultiHead
+from adanet_tpu.core.heads import MultiLabelHead
 from adanet_tpu.core.heads import RegressionHead
 from adanet_tpu.core.iteration import Iteration
 from adanet_tpu.core.iteration import IterationBuilder
@@ -38,6 +39,7 @@ __all__ = [
     "IterationBuilder",
     "MultiClassHead",
     "MultiHead",
+    "MultiLabelHead",
     "Objective",
     "RegressionHead",
     "EventFileWriter",

@@ -93,35 +93,25 @@ class RegressionHead(Head):
         return {"average_loss": self.loss(logits, labels, weights)}
 
 
-class BinaryClassificationHead(Head):
-    """Sigmoid cross-entropy binary classification head (logits dim 1)."""
+class _SigmoidHead(Head):
+    """Shared sigmoid cross-entropy body (per-dimension independent labels)."""
 
-    def __init__(self, name: str = "binary_head"):
+    def __init__(self, logits_dimension: int, name: str):
         super().__init__(name)
+        self._logits_dimension = logits_dimension
 
     @property
     def logits_dimension(self) -> int:
-        return 1
+        return self._logits_dimension
 
     def loss(self, logits, labels, weights=None):
         logits = jnp.asarray(logits, jnp.float32)
-        _check_logits_dimension(logits, 1, self.name)
+        _check_logits_dimension(logits, self._logits_dimension, self.name)
         labels = jnp.reshape(jnp.asarray(labels, jnp.float32), logits.shape)
         per_example = jnp.mean(
             optax.sigmoid_binary_cross_entropy(logits, labels), axis=-1
         )
         return _weighted_mean(per_example, weights)
-
-    def predictions(self, logits):
-        probabilities = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
-        return {
-            "logits": logits,
-            "logistic": probabilities,
-            "probabilities": jnp.concatenate(
-                [1.0 - probabilities, probabilities], axis=-1
-            ),
-            "class_ids": jnp.asarray(probabilities > 0.5, jnp.int32),
-        }
 
     def eval_metrics(self, logits, labels, weights=None):
         logits = jnp.asarray(logits, jnp.float32)
@@ -136,6 +126,24 @@ class BinaryClassificationHead(Head):
         return {
             "average_loss": self.loss(logits, labels, weights),
             "accuracy": accuracy,
+        }
+
+
+class BinaryClassificationHead(_SigmoidHead):
+    """Sigmoid cross-entropy binary classification head (logits dim 1)."""
+
+    def __init__(self, name: str = "binary_head"):
+        super().__init__(1, name)
+
+    def predictions(self, logits):
+        probabilities = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
+        return {
+            "logits": logits,
+            "logistic": probabilities,
+            "probabilities": jnp.concatenate(
+                [1.0 - probabilities, probabilities], axis=-1
+            ),
+            "class_ids": jnp.asarray(probabilities > 0.5, jnp.int32),
         }
 
 
@@ -182,6 +190,28 @@ class MultiClassHead(Head):
         return {
             "average_loss": self.loss(logits, labels, weights),
             "accuracy": accuracy,
+        }
+
+
+class MultiLabelHead(_SigmoidHead):
+    """Independent sigmoid cross-entropy over `n_classes` labels.
+
+    Labels are multi-hot arrays of shape [batch, n_classes]; the equivalent
+    of `tf.estimator.MultiLabelHead` that reference users plug in.
+    """
+
+    def __init__(self, n_classes: int, name: str = "multilabel_head"):
+        if n_classes < 2:
+            raise ValueError("n_classes must be >= 2, got %d" % n_classes)
+        super().__init__(n_classes, name)
+
+    def predictions(self, logits):
+        logits = jnp.asarray(logits, jnp.float32)
+        probabilities = jax.nn.sigmoid(logits)
+        return {
+            "logits": logits,
+            "probabilities": probabilities,
+            "class_ids": jnp.asarray(probabilities > 0.5, jnp.int32),
         }
 
 

@@ -10,6 +10,7 @@ previous depth recovered from the frozen subnetwork's `shared` state.
 from __future__ import annotations
 
 import math
+from collections.abc import Mapping
 from functools import partial
 from typing import Any, List, Optional
 
@@ -43,7 +44,9 @@ class _SimpleDNN(nn.Module):
                 x = nn.Dropout(rate=self.dropout, deterministic=not training)(
                     x
                 )
-        if isinstance(self.logits_dimension, dict):
+        # Mapping (not dict): flax wraps dict module attributes in
+        # FrozenDict, which is a Mapping but not a dict subclass.
+        if isinstance(self.logits_dimension, Mapping):
             logits = {
                 key: nn.Dense(dim, name="logits_%s" % key)(x)
                 for key, dim in sorted(self.logits_dimension.items())

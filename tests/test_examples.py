@@ -1,0 +1,94 @@
+"""Example search-space tests
+(reference: adanet/examples/simple_dnn_test.py)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+import adanet_tpu
+from adanet_tpu.core.iteration import IterationBuilder
+from adanet_tpu.ensemble import ComplexityRegularizedEnsembler, GrowStrategy
+from adanet_tpu.examples import simple_cnn, simple_dnn
+
+from helpers import linear_dataset
+
+
+def test_simple_dnn_generator_candidates():
+    gen = simple_dnn.Generator(initial_num_layers=0, layer_size=8)
+    builders = gen.generate_candidates(None, 0, [], [])
+    assert [b.name for b in builders] == ["linear", "1_layer_dnn"]
+    # Reports carry the search-space hparams.
+    report = builders[1].build_subnetwork_report()
+    assert report.hparams["num_layers"] == 1
+    assert report.attributes["complexity"] == 1.0
+
+
+def test_simple_dnn_deepens_from_shared(tmp_path):
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=simple_dnn.Generator(
+            initial_num_layers=0, layer_size=8, dropout=0.1
+        ),
+        max_iteration_steps=4,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        max_iterations=2,
+        force_grow=True,
+        model_dir=str(tmp_path / "m"),
+        log_every_steps=0,
+    )
+    est.train(linear_dataset(), max_steps=100)
+    import json
+    import os
+
+    arch = json.load(open(os.path.join(est.model_dir, "architecture-1.json")))
+    names = [s["builder_name"] for s in arch["subnetworks"]]
+    assert len(names) == 2  # grew by one member
+    # The t=1 candidates were proposed relative to the t=0 winner's depth.
+    assert all(
+        n in ("linear", "1_layer_dnn", "2_layer_dnn") for n in names
+    )
+
+
+def test_simple_cnn_generator_widens_and_deepens():
+    gen = simple_cnn.CNNGenerator(initial_num_blocks=1, channels=8)
+    builders = gen.generate_candidates(None, 0, [], [])
+    assert [b.name for b in builders] == ["cnn_1b_8c", "cnn_2b_8c"]
+
+    batch = (
+        {"image": np.zeros((4, 16, 16, 3), np.float32)},
+        np.zeros((4,), np.int32),
+    )
+    factory = IterationBuilder(
+        head=adanet_tpu.MultiClassHead(3),
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.01))],
+        ensemble_strategies=[GrowStrategy()],
+    )
+    it = factory.build_iteration(0, builders, None)
+    state = it.init_state(jax.random.PRNGKey(0), batch)
+    state, metrics = it.train_step(state, batch)
+    for name in it.candidate_names():
+        assert np.isfinite(float(metrics["adanet_loss/%s" % name]))
+
+
+def test_simple_dnn_multihead_support():
+    """simple_dnn produces dict logits under a MultiHead."""
+    head = adanet_tpu.MultiHead(
+        [
+            adanet_tpu.RegressionHead(name="reg"),
+            adanet_tpu.MultiClassHead(3, name="cls"),
+        ]
+    )
+    gen = simple_dnn.Generator(initial_num_layers=1, layer_size=8)
+    builders = gen.generate_candidates(None, 0, [], [])
+    module = builders[0].build_subnetwork(head.logits_dimension)
+    rng = np.random.RandomState(0)
+    features = {"x": rng.randn(4, 2).astype(np.float32)}
+    variables = module.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        features,
+        training=True,
+    )
+    out = module.apply(variables, features, training=False)
+    assert set(out.logits) == {"reg", "cls"}
+    assert out.logits["cls"].shape == (4, 3)

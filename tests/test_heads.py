@@ -67,3 +67,19 @@ def test_multi_head():
     assert "cls/accuracy" in metrics
     preds = head.predictions(logits)
     assert "reg/predictions" in preds
+
+
+def test_multilabel_head():
+    from adanet_tpu.core.heads import MultiLabelHead
+
+    head = MultiLabelHead(n_classes=3)
+    logits = jnp.asarray([[10.0, -10.0, 10.0], [-10.0, 10.0, -10.0]])
+    labels = jnp.asarray([[1, 0, 1], [0, 1, 0]], jnp.float32)
+    assert head.logits_dimension == 3
+    assert float(head.loss(logits, labels)) < 1e-3
+    metrics = head.eval_metrics(logits, labels)
+    np.testing.assert_allclose(metrics["accuracy"], 1.0)
+    preds = head.predictions(logits)
+    assert preds["class_ids"].tolist() == [[1, 0, 1], [0, 1, 0]]
+    with pytest.raises(ValueError):
+        head.loss(jnp.zeros((2, 4)), labels)

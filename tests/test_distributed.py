@@ -186,6 +186,32 @@ def test_multi_process_chief_worker(tmp_path):
     assert b"ROLE 1 DONE" in worker_out
 
 
+def test_graft_dryrun_self_provisions_virtual_mesh():
+    """The driver calls ``dryrun_multichip(8)`` on a host with one real
+    chip; the entrypoint must provision its own virtual CPU mesh instead
+    of raising (round-1 driver contract failure)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # Simulate the driver: no JAX device hints in the environment.
+    for key in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_NUM_CPU_DEVICES"):
+        env.pop(key, None)
+    code = (
+        "import __graft_entry__ as g; g.dryrun_multichip(2); "
+        "import jax; assert jax.devices()[0].platform == 'cpu'"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+
+
 def test_estimator_with_round_robin_placement(tmp_path):
     """Full Estimator lifecycle with candidate-parallel training placement."""
     import adanet_tpu

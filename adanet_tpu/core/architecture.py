@@ -23,12 +23,14 @@ class Architecture:
         ensembler_name: str,
         global_step: int = 0,
         replay_indices: Optional[Sequence[int]] = None,
+        iteration_number: int = 0,
     ):
         self._ensemble_candidate_name = ensemble_candidate_name
         self._ensembler_name = ensembler_name
         self._global_step = int(global_step)
         self._subnets: List[Tuple[int, str]] = []
         self._replay_indices: List[int] = list(replay_indices or [])
+        self._iteration_number = int(iteration_number)
 
     @property
     def ensemble_candidate_name(self) -> str:
@@ -41,6 +43,10 @@ class Architecture:
     @property
     def global_step(self) -> int:
         return self._global_step
+
+    @property
+    def iteration_number(self) -> int:
+        return self._iteration_number
 
     @property
     def subnetworks(self) -> Sequence[Tuple[int, str]]:
@@ -86,6 +92,10 @@ class Architecture:
             "ensemble_candidate_name": self._ensemble_candidate_name,
             "ensembler_name": self._ensembler_name,
             "global_step": self._global_step,
+            # Top-level iteration_number for on-disk parity with the
+            # reference's serialized architectures
+            # (reference: adanet/core/architecture.py:132-151).
+            "iteration_number": self._iteration_number,
             "subnetworks": [
                 {"iteration_number": t, "builder_name": name}
                 for t, name in self._subnets
@@ -103,6 +113,7 @@ class Architecture:
             ensembler_name=obj["ensembler_name"],
             global_step=obj.get("global_step", 0),
             replay_indices=obj.get("replay_indices", []),
+            iteration_number=obj.get("iteration_number", 0),
         )
         for entry in obj.get("subnetworks", []):
             arch.add_subnetwork(

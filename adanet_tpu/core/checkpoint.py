@@ -46,10 +46,30 @@ class CheckpointInfo:
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
-    with os.fdopen(fd, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    """Write-then-rename with fsync, so a host crash cannot leave the
+    manifest pointing at a payload that never reached disk."""
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dir_fd = os.open(directory, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
 
 def _atomic_write_json(path: str, obj) -> None:
@@ -168,10 +188,12 @@ def frozen_to_payload(frozen) -> Dict[str, Any]:
             if frozen.ensembler_params is None
             else {"value": jax.device_get(frozen.ensembler_params)}
         ),
+        # Optional-field encoding ({} = unset), like `weight`/`shared`
+        # above; older payloads used an inf sentinel, still read below.
         "final_ema": (
-            float(frozen.final_ema)
-            if frozen.final_ema is not None
-            else float("inf")
+            {}
+            if frozen.final_ema is None
+            else {"value": float(frozen.final_ema)}
         ),
     }
 
@@ -196,5 +218,12 @@ def payload_into_frozen(payload: Dict[str, Any], frozen) -> None:
         shared = entry["shared"]
         ws.subnetwork.shared = shared.get("value") if shared else None
     frozen.ensembler_params = payload["ensembler_params"].get("value")
-    ema = payload.get("final_ema", float("inf"))
-    frozen.final_ema = None if ema == float("inf") else float(ema)
+    ema = payload.get("final_ema")
+    if isinstance(ema, dict):
+        frozen.final_ema = (
+            float(ema["value"]) if "value" in ema else None
+        )
+    else:  # legacy inf-sentinel payloads (round 1)
+        frozen.final_ema = (
+            None if ema is None or ema == float("inf") else float(ema)
+        )

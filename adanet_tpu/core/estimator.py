@@ -49,6 +49,7 @@ from adanet_tpu.distributed.executor import RoundRobinExecutor
 from adanet_tpu.distributed.placement import RoundRobinStrategy
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
+from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
 
 _LOG = logging.getLogger("adanet_tpu")
 
@@ -676,11 +677,23 @@ class Estimator:
         return state
 
     def _save_iteration_state(self, info, iteration_number, state) -> None:
+        stale = info.iteration_state_file
         filename = ckpt_lib.iteration_state_filename(info.global_step)
         ckpt_lib.save_pytree(self._model_dir, filename, state)
         info.iteration_number = iteration_number
         info.iteration_state_file = filename
         ckpt_lib.write_manifest(self._model_dir, info)
+        # The manifest now points at the new state; the superseded file
+        # would otherwise accumulate unboundedly over long searches.
+        self._remove_state_file(stale, keep=filename)
+
+    def _remove_state_file(self, filename, keep=None) -> None:
+        if not filename or filename == keep:
+            return
+        try:
+            os.remove(os.path.join(self._model_dir, filename))
+        except OSError:
+            pass
 
     # ------------------------------------------------- bookkeeping (between)
 
@@ -748,10 +761,12 @@ class Estimator:
             )
             self._report_accessor.write_iteration_report(t, reports)
 
+        stale_state = info.iteration_state_file
         info.iteration_number = t + 1
         info.iteration_state_file = None
         info.replay_indices = frozen.architecture.replay_indices
         ckpt_lib.write_manifest(self._model_dir, info)
+        self._remove_state_file(stale_state)
         if self._summary is not None:
             # Scopes are per-iteration (t<N>_...); close them so open file
             # handles stay bounded across long searches.
@@ -866,14 +881,13 @@ class Estimator:
                 out.update(self._metric_fn(ensemble.logits, labels))
             return out
 
-        totals: Dict[str, float] = {}
-        count = 0
+        # Per-batch means weighted by example count (a ragged final batch
+        # must not be over-weighted; ADVICE round 1).
+        acc = WeightedMeanAccumulator()
         for features, labels in self._eval_batches(data, steps):
             host = jax.device_get(metrics_fn(params, features, labels))
-            for key, value in host.items():
-                totals[key] = totals.get(key, 0.0) + float(value)
-            count += 1
-        result = {key: value / count for key, value in totals.items()}
+            acc.add(host, batch_example_count((features, labels)))
+        result = acc.means()
         self._write_eval_summaries({name: result}, self.latest_global_step())
         result["best_ensemble"] = name
         result["global_step"] = self.latest_global_step()
@@ -916,19 +930,14 @@ class Estimator:
         state = self._init_or_restore_state(iteration, first, info)
 
         names = iteration.candidate_names()
-        totals: Dict[str, Dict[str, float]] = {n: {} for n in names}
-        count = 0
+        accs = {n: WeightedMeanAccumulator() for n in names}
         for batch in self._eval_batches(data, steps):
+            size = batch_example_count(batch)
             results = iteration.eval_step(state, batch)
             host = jax.device_get({n: results[n] for n in names})
             for n in names:
-                for key, value in host[n].items():
-                    totals[n][key] = totals[n].get(key, 0.0) + float(value)
-            count += 1
-        results = {
-            n: {key: value / count for key, value in metrics.items()}
-            for n, metrics in totals.items()
-        }
+                accs[n].add(host[n], size)
+        results = {n: accs[n].means() for n in names}
         self._write_eval_summaries(results, info.global_step)
         return results
 

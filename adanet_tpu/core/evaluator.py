@@ -15,6 +15,8 @@ from typing import Callable, List, Optional
 import jax
 import numpy as np
 
+from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
+
 
 class Objective(str, enum.Enum):
     """Direction of the evaluation metric (reference: evaluator.py:36-50)."""
@@ -72,18 +74,28 @@ class Evaluator:
         return np.nanargmax
 
     def evaluate(self, iteration, state) -> List[float]:
-        """Mean metric per candidate, in `iteration.candidate_names()` order."""
+        """Mean metric per candidate, in `iteration.candidate_names()` order.
+
+        Per-batch means are weighted by example count so a ragged final
+        batch does not skew candidate scores (the reference streams
+        example-weighted means, reference: adanet/core/evaluator.py:97-140).
+        """
         names = iteration.candidate_names()
-        totals = {name: 0.0 for name in names}
-        count = 0
+        acc = WeightedMeanAccumulator()
         for batch in self._input_fn():
-            if self._steps is not None and count >= self._steps:
+            if self._steps is not None and acc.batches >= self._steps:
                 break
+            n = batch_example_count(batch)
             results = iteration.eval_step(state, batch)
             host = jax.device_get({name: results[name] for name in names})
-            for name in names:
-                totals[name] += float(host[name][self._metric_name])
-            count += 1
-        if count == 0:
+            acc.add(
+                {
+                    name: float(host[name][self._metric_name])
+                    for name in names
+                },
+                n,
+            )
+        if acc.batches == 0:
             raise ValueError("Evaluator input_fn yielded no batches.")
-        return [totals[name] / count for name in names]
+        means = acc.means()
+        return [means[name] for name in names]

@@ -839,6 +839,7 @@ class IterationBuilder:
                     architecture = Architecture(
                         ensemble_candidate_name=cand.name,
                         ensembler_name=ensembler.name,
+                        iteration_number=iteration_number,
                         replay_indices=(
                             previous_ensemble.architecture.replay_indices
                             if previous_ensemble

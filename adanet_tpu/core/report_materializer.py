@@ -14,6 +14,7 @@ from typing import Callable, List, Optional, Sequence
 import jax
 
 from adanet_tpu.subnetwork.report import MaterializedReport, Report
+from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
 
 
 class ReportMaterializer:
@@ -69,17 +70,17 @@ class ReportMaterializer:
             return out
 
         jitted = jax.jit(batch_metrics)
-        totals = {name: {} for name in reports}
+        # Example-weighted means, so a ragged final batch is not
+        # over-weighted (ADVICE round 1).
+        accs = {name: WeightedMeanAccumulator() for name in reports}
         count = 0
         for features, labels in self._input_fn():
             if self._steps is not None and count >= self._steps:
                 break
+            n = batch_example_count((features, labels))
             host = jax.device_get(jitted(state, features, labels))
             for name, metrics in host.items():
-                for key, value in metrics.items():
-                    totals[name][key] = totals[name].get(key, 0.0) + float(
-                        value
-                    )
+                accs[name].add(metrics, n)
             count += 1
         if count == 0:
             raise ValueError("Report input_fn yielded no batches.")
@@ -93,10 +94,7 @@ class ReportMaterializer:
                     name=spec.name,
                     hparams=dict(report.hparams),
                     attributes=dict(report.attributes),
-                    metrics={
-                        key: value / count
-                        for key, value in totals[spec.name].items()
-                    },
+                    metrics=accs[spec.name].means(),
                     included_in_final_ensemble=(
                         spec.name in set(included_subnetwork_names)
                     ),

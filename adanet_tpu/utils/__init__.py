@@ -1,7 +1,17 @@
 """Shared utilities."""
 
+from adanet_tpu.utils.batches import (
+    WeightedMeanAccumulator,
+    batch_example_count,
+)
 from adanet_tpu.utils.trees import tree_finite
 from adanet_tpu.utils.trees import tree_where
 from adanet_tpu.utils.trees import tree_zeros_like
 
-__all__ = ["tree_finite", "tree_where", "tree_zeros_like"]
+__all__ = [
+    "WeightedMeanAccumulator",
+    "batch_example_count",
+    "tree_finite",
+    "tree_where",
+    "tree_zeros_like",
+]

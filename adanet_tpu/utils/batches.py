@@ -1,0 +1,63 @@
+"""Batch utilities shared by the evaluation paths."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+
+def batch_example_count(batch) -> int:
+    """Number of examples in a (features, labels) batch.
+
+    The leading dimension of the first array leaf. Used to weight per-batch
+    metric means by example count so a ragged final batch is not
+    over-weighted — the analogue of the reference's example-weighted
+    streaming means (reference: adanet/core/evaluator.py:97-140 via
+    tf.metrics.mean). Reads `.shape` directly (no host copy for device
+    arrays); np.asarray only as a fallback for list-like leaves.
+    """
+    for leaf in jax.tree_util.tree_leaves(batch):
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is None:
+            leaf = np.asarray(leaf)
+            ndim = leaf.ndim
+        if ndim >= 1:
+            return int(leaf.shape[0])
+    raise ValueError("Batch has no array leaves with a leading dimension.")
+
+
+class WeightedMeanAccumulator:
+    """Streams example-weighted means of per-batch metric means.
+
+    One shared implementation for every eval loop (Evaluator, Estimator
+    eval paths, ReportMaterializer), so the weighting semantics cannot
+    silently diverge between them.
+    """
+
+    def __init__(self):
+        self._totals: Dict[str, float] = {}
+        self._examples = 0
+        self._batches = 0
+
+    @property
+    def batches(self) -> int:
+        return self._batches
+
+    def add(self, metrics: Dict[str, float], example_count: int) -> None:
+        """Accumulates one batch's metric means, weighted by its size."""
+        for key, value in metrics.items():
+            self._totals[key] = (
+                self._totals.get(key, 0.0) + float(value) * example_count
+            )
+        self._examples += int(example_count)
+        self._batches += 1
+
+    def means(self) -> Dict[str, float]:
+        if self._examples == 0:
+            raise ValueError("No examples accumulated.")
+        return {
+            key: value / self._examples
+            for key, value in self._totals.items()
+        }

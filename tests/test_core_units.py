@@ -35,6 +35,20 @@ class TestArchitecture:
         assert restored.subnetworks == ((0, "linear"), (1, "dnn"))
         assert restored.replay_indices == [2]
 
+    def test_serialize_carries_iteration_number(self):
+        """On-disk parity: the reference writes a top-level
+        iteration_number (reference: adanet/core/architecture.py:132-151)."""
+        import json
+
+        arch = Architecture("cand", "mean", iteration_number=3)
+        assert json.loads(arch.serialize())["iteration_number"] == 3
+        restored = Architecture.deserialize(arch.serialize())
+        assert restored.iteration_number == 3
+        # Legacy round-1 JSON without the key still deserializes.
+        legacy = dict(json.loads(arch.serialize()))
+        del legacy["iteration_number"]
+        assert Architecture.deserialize(json.dumps(legacy)).iteration_number == 0
+
     def test_grouped_by_iteration(self):
         arch = Architecture("c", "e")
         arch.add_subnetwork(0, "a")
@@ -108,6 +122,28 @@ class TestEvaluatorObjective:
         assert maximize.metric_name == "accuracy"
 
 
+class TestEvaluatorWeighting:
+    def test_ragged_final_batch_is_example_weighted(self):
+        """A short final batch must contribute proportionally to its
+        example count, not one full batch-weight (ADVICE round 1)."""
+
+        class StubIteration:
+            def candidate_names(self):
+                return ["a"]
+
+            def eval_step(self, state, batch):
+                _, labels = batch
+                return {"a": {"adanet_loss": jnp.mean(labels)}}
+
+        def input_fn():
+            yield {"x": np.zeros((4, 1))}, np.zeros((4,), np.float32)
+            yield {"x": np.zeros((1, 1))}, np.full((1,), 8.0, np.float32)
+
+        values = Evaluator(input_fn=input_fn).evaluate(StubIteration(), None)
+        # Example-weighted: (4*0 + 1*8) / 5 = 1.6; unweighted would be 4.0.
+        np.testing.assert_allclose(values, [1.6], rtol=1e-6)
+
+
 class TestReplayConfig:
     def test_indices(self):
         config = replay.Config(best_ensemble_indices=[1, 0])
@@ -146,6 +182,57 @@ class TestCheckpoint:
             restored["members"][1]["params"]["w"], np.ones((2, 2))
         )
         assert restored["members"][0]["complexity"] == 1.5
+
+    def test_final_ema_optional_encoding(self):
+        """final_ema uses {}/{'value': x} like the other optional fields;
+        the legacy inf sentinel (round 1) still restores as None."""
+        import types
+
+        def frozen_with_ema(ema):
+            return types.SimpleNamespace(
+                weighted_subnetworks=[], ensembler_params=None, final_ema=ema
+            )
+
+        payload = ckpt_lib.frozen_to_payload(frozen_with_ema(None))
+        assert payload["final_ema"] == {}
+        payload = ckpt_lib.frozen_to_payload(frozen_with_ema(float("inf")))
+        assert payload["final_ema"] == {"value": float("inf")}
+
+        target = frozen_with_ema("sentinel")
+        ckpt_lib.payload_into_frozen(
+            {"members": [], "ensembler_params": {}, "final_ema": {}}, target
+        )
+        assert target.final_ema is None
+        ckpt_lib.payload_into_frozen(
+            {
+                "members": [],
+                "ensembler_params": {},
+                "final_ema": {"value": float("inf")},
+            },
+            target,
+        )
+        assert target.final_ema == float("inf")
+        # Legacy float encoding: inf meant unset, finite means itself.
+        ckpt_lib.payload_into_frozen(
+            {
+                "members": [],
+                "ensembler_params": {},
+                "final_ema": float("inf"),
+            },
+            target,
+        )
+        assert target.final_ema is None
+        ckpt_lib.payload_into_frozen(
+            {"members": [], "ensembler_params": {}, "final_ema": 0.25}, target
+        )
+        assert target.final_ema == 0.25
+
+    def test_atomic_write_cleans_temp_on_failure(self, tmp_path):
+        with pytest.raises(TypeError):
+            ckpt_lib._atomic_write_bytes(
+                str(tmp_path / "out.bin"), "not-bytes"
+            )
+        assert list(tmp_path.iterdir()) == []
 
     def test_pytree_round_trip_with_target(self, tmp_path):
         import optax

@@ -82,6 +82,33 @@ def test_resume_from_checkpoint(tmp_path):
     assert np.isfinite(metrics["average_loss"])
 
 
+def test_stale_mid_iteration_checkpoints_are_pruned(tmp_path):
+    """Superseded ckpt-<step>.msgpack files must not accumulate over long
+    searches (ADVICE round 1): only the manifest's current state file may
+    remain, and none after an iteration completes."""
+    import glob
+
+    est = _make_estimator(
+        tmp_path, max_iterations=2, save_checkpoint_steps=2
+    )
+    # Stop mid-iteration: exactly the manifest's state file remains.
+    est.train(linear_dataset(), max_steps=5)
+    files = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(est.model_dir, "ckpt-*.msgpack"))
+    )
+    from adanet_tpu.core import checkpoint as ckpt_lib
+
+    info = ckpt_lib.read_manifest(est.model_dir)
+    assert files == [info.iteration_state_file]
+
+    # Finish the search: completed iterations leave no mid-iteration state.
+    _make_estimator(
+        tmp_path, max_iterations=2, save_checkpoint_steps=2
+    ).train(linear_dataset(), max_steps=100)
+    assert glob.glob(os.path.join(est.model_dir, "ckpt-*.msgpack")) == []
+
+
 def test_training_continues_decreasing_loss(tmp_path):
     est = _make_estimator(tmp_path, max_iterations=3, max_iteration_steps=20)
     est.train(linear_dataset(), max_steps=200)

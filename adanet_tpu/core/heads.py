@@ -49,8 +49,86 @@ class Head(abc.ABC):
 def _weighted_mean(values, weights):
     if weights is None:
         return jnp.mean(values)
-    weights = jnp.broadcast_to(jnp.asarray(weights, values.dtype), values.shape)
+    weights = jnp.asarray(weights, values.dtype)
+    # Accept [batch] and [batch, 1] weight conventions alike.
+    while weights.ndim > values.ndim and weights.shape[-1] == 1:
+        weights = jnp.squeeze(weights, -1)
+    weights = jnp.broadcast_to(weights, values.shape)
     return jnp.sum(values * weights) / jnp.maximum(jnp.sum(weights), 1e-12)
+
+
+def _binary_auc(probabilities, labels, weights=None):
+    """Per-batch ROC AUC via the tie-corrected Mann-Whitney statistic.
+
+    AUC = P(score(pos) > score(neg)) with ties counted half, optionally
+    example-weighted. Computed in O(n log n) by sorting scores and, for
+    each positive, accumulating the negative weight strictly below it plus
+    half the tied negative weight (identical to the all-pairs statistic
+    without any n^2 buffer). Engines average per-batch values
+    example-weighted, which approximates the reference's streamed
+    `tf.metrics.auc`; batches lacking one of the classes contribute
+    chance (0.5).
+    """
+    p = jnp.reshape(jnp.asarray(probabilities, jnp.float32), (-1,))
+    y = jnp.reshape(jnp.asarray(labels, jnp.float32), (-1,))
+    if weights is None:
+        w = jnp.ones_like(p)
+    else:
+        w = jnp.reshape(jnp.asarray(weights, jnp.float32), (-1,))
+    pos_w = w * jnp.asarray(y > 0.5, jnp.float32)
+    neg_w = w - pos_w
+    order = jnp.argsort(p)
+    sorted_p = p[order]
+    sorted_pos_w = pos_w[order]
+    sorted_neg_w = neg_w[order]
+    # S[k] = total negative weight in the first k sorted entries.
+    neg_below = jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32), jnp.cumsum(sorted_neg_w)]
+    )
+    left = jnp.searchsorted(sorted_p, sorted_p, side="left")
+    right = jnp.searchsorted(sorted_p, sorted_p, side="right")
+    strict = neg_below[left]
+    tied = neg_below[right] - neg_below[left]
+    numerator = jnp.sum(sorted_pos_w * (strict + 0.5 * tied))
+    n_pos = jnp.sum(pos_w)
+    n_neg = jnp.sum(neg_w)
+    defined = (n_pos > 0) & (n_neg > 0)
+    return jnp.where(
+        defined, numerator / jnp.maximum(n_pos * n_neg, 1e-12), 0.5
+    )
+
+
+def _precision_recall(predicted, labels, weights=None):
+    """(precision, recall) over {0,1} arrays, optionally example-weighted;
+    0 when undefined (the reference's `tf.metrics.precision/recall`
+    zero-denominator behavior)."""
+    predicted = jnp.asarray(predicted, jnp.float32)
+    labels = jnp.asarray(labels, jnp.float32)
+    w = (
+        jnp.ones_like(predicted)
+        if weights is None
+        else jnp.asarray(weights, jnp.float32)
+    )
+    true_pos = jnp.sum(w * predicted * labels)
+    pred_pos = jnp.sum(w * predicted)
+    actual_pos = jnp.sum(w * labels)
+    precision = jnp.where(
+        pred_pos > 0, true_pos / jnp.maximum(pred_pos, 1e-12), 0.0
+    )
+    recall = jnp.where(
+        actual_pos > 0, true_pos / jnp.maximum(actual_pos, 1e-12), 0.0
+    )
+    return precision, recall
+
+
+def _broadcast_weights(weights, target):
+    """Per-example weights broadcast to a [batch, ...] target shape."""
+    if weights is None:
+        return None
+    w = jnp.asarray(weights, jnp.float32)
+    while w.ndim < target.ndim:
+        w = w[..., None]
+    return jnp.broadcast_to(w, target.shape)
 
 
 def _check_logits_dimension(logits, expected: int, head_name: str) -> None:
@@ -114,8 +192,14 @@ class _SigmoidHead(Head):
         return _weighted_mean(per_example, weights)
 
     def eval_metrics(self, logits, labels, weights=None):
+        """Reference canned-head metric set (accuracy, AUC, precision,
+        recall, label/prediction means; reference:
+        adanet/core/ensemble_builder.py:571-583 via head.create_estimator_
+        spec). For multi-label heads AUC/precision/recall are
+        micro-averaged over the flattened (example, class) pairs."""
         logits = jnp.asarray(logits, jnp.float32)
         labels_f = jnp.reshape(jnp.asarray(labels, jnp.float32), logits.shape)
+        probabilities = jax.nn.sigmoid(logits)
         predicted = jnp.asarray(logits > 0.0, jnp.float32)
         accuracy = _weighted_mean(
             jnp.mean(
@@ -123,9 +207,21 @@ class _SigmoidHead(Head):
             ),
             weights,
         )
+        w_full = _broadcast_weights(weights, labels_f)
+        precision, recall = _precision_recall(predicted, labels_f, w_full)
+        label_mean = _weighted_mean(jnp.mean(labels_f, axis=-1), weights)
         return {
             "average_loss": self.loss(logits, labels, weights),
             "accuracy": accuracy,
+            "auc": _binary_auc(probabilities, labels_f, w_full),
+            "precision": precision,
+            "recall": recall,
+            "label/mean": label_mean,
+            "prediction/mean": _weighted_mean(
+                jnp.mean(probabilities, axis=-1), weights
+            ),
+            # Accuracy of always predicting the majority class.
+            "accuracy_baseline": jnp.maximum(label_mean, 1.0 - label_mean),
         }
 
 
@@ -150,11 +246,29 @@ class BinaryClassificationHead(_SigmoidHead):
 class MultiClassHead(Head):
     """Softmax cross-entropy head over `n_classes` with integer labels."""
 
-    def __init__(self, n_classes: int, name: str = "multiclass_head"):
+    def __init__(
+        self,
+        n_classes: int,
+        name: str = "multiclass_head",
+        top_k: Optional[int] = None,
+    ):
+        """Args:
+          n_classes: number of classes (logits dimension).
+          top_k: emit a `top_<k>_accuracy` eval metric. Defaults to 5 when
+            `n_classes > 5` (the ImageNet-style convention), disabled
+            otherwise; pass an explicit k to override.
+        """
         super().__init__(name)
         if n_classes < 2:
             raise ValueError("n_classes must be >= 2, got %d" % n_classes)
         self._n_classes = n_classes
+        if top_k is None:
+            top_k = 5 if n_classes > 5 else 0
+        if top_k < 0 or top_k >= n_classes:
+            raise ValueError(
+                "top_k=%d must be in [0, n_classes=%d)" % (top_k, n_classes)
+            )
+        self._top_k = int(top_k)
 
     @property
     def logits_dimension(self) -> int:
@@ -187,10 +301,24 @@ class MultiClassHead(Head):
             ),
             weights,
         )
-        return {
+        out = {
             "average_loss": self.loss(logits, labels, weights),
             "accuracy": accuracy,
         }
+        if self._top_k:
+            # Label's logit must be among the k largest: count strictly
+            # larger logits (ties resolved optimistically, matching
+            # tf.math.in_top_k).
+            label_logit = jnp.take_along_axis(
+                logits, labels_i[:, None], axis=-1
+            )
+            n_larger = jnp.sum(
+                jnp.asarray(logits > label_logit, jnp.float32), axis=-1
+            )
+            out["top_%d_accuracy" % self._top_k] = _weighted_mean(
+                jnp.asarray(n_larger < self._top_k, jnp.float32), weights
+            )
+        return out
 
 
 class MultiLabelHead(_SigmoidHead):

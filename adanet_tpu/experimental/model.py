@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
+
 
 class Model:
     """A trainable (module, params) pair with fit/evaluate.
@@ -124,17 +126,19 @@ class Model:
                 values.append(self.metrics[name](out, labels))
             return values
 
-        totals = None
-        count = 0
+        # Example-weighted means, matching the core eval loops (a ragged
+        # final batch must not be over-weighted).
+        acc = WeightedMeanAccumulator()
         for features, labels in dataset:
             self._ensure_initialized(features)
             values = jax.device_get(
                 batch_metrics(self.variables, features, labels)
             )
-            if totals is None:
-                totals = [0.0] * len(values)
-            totals = [t + float(v) for t, v in zip(totals, values)]
-            count += 1
-        if count == 0:
+            acc.add(
+                {str(i): float(v) for i, v in enumerate(values)},
+                batch_example_count((features, labels)),
+            )
+        if acc.batches == 0:
             raise ValueError("evaluate() got an empty dataset.")
-        return [t / count for t in totals]
+        means = acc.means()
+        return [means[str(i)] for i in range(len(means))]

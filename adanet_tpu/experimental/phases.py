@@ -244,22 +244,23 @@ class MeanEnsemble(Model):
         return jnp.mean(jnp.stack(outs, axis=0), axis=0)
 
     def evaluate(self, dataset):
-        totals = None
-        count = 0
+        # Example-weighted means, matching the core eval loops.
+        from adanet_tpu.utils import (
+            WeightedMeanAccumulator,
+            batch_example_count,
+        )
+
+        acc = WeightedMeanAccumulator()
         for features, labels in dataset:
             out = self(features)
-            values = [float(self.loss_fn(out, labels))]
-            for name in sorted(self.metrics):
-                values.append(float(self.metrics[name](out, labels)))
-            totals = (
-                values
-                if totals is None
-                else [t + v for t, v in zip(totals, values)]
-            )
-            count += 1
-        if count == 0:
+            values = {"0": float(self.loss_fn(out, labels))}
+            for i, name in enumerate(sorted(self.metrics)):
+                values[str(i + 1)] = float(self.metrics[name](out, labels))
+            acc.add(values, batch_example_count((features, labels)))
+        if acc.batches == 0:
             raise ValueError("evaluate() got an empty dataset.")
-        return [t / count for t in totals]
+        means = acc.means()
+        return [means[str(i)] for i in range(len(means))]
 
 
 class MeanEnsembler:

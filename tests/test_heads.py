@@ -44,6 +44,120 @@ def test_multiclass_head():
     assert head.predictions(logits)["class_ids"].tolist() == [0, 1]
 
 
+def test_binary_head_rich_metrics():
+    """AUC / precision / recall / means (the reference canned-head metric
+    set, reference: adanet/core/ensemble_builder.py:571-583)."""
+    head = BinaryClassificationHead()
+    # probabilities ~ [0.88, 0.27, 0.73, 0.12]; labels [1, 0, 0, 1]
+    logits = jnp.asarray([[2.0], [-1.0], [1.0], [-2.0]])
+    labels = jnp.asarray([[1.0], [0.0], [0.0], [1.0]])
+    m = head.eval_metrics(logits, labels)
+    # Pairs (pos, neg): (2,-1)W (2,1)W (-2,-1)L (-2,1)L -> AUC = 2/4.
+    np.testing.assert_allclose(m["auc"], 0.5)
+    # predicted = [1, 0, 1, 0]: TP=1, FP=1, FN=1.
+    np.testing.assert_allclose(m["precision"], 0.5)
+    np.testing.assert_allclose(m["recall"], 0.5)
+    np.testing.assert_allclose(m["label/mean"], 0.5)
+    np.testing.assert_allclose(m["accuracy_baseline"], 0.5)
+    assert 0.0 < float(m["prediction/mean"]) < 1.0
+
+    # Perfect ranking: AUC = 1.
+    m = head.eval_metrics(
+        jnp.asarray([[3.0], [2.0], [-2.0], [-3.0]]),
+        jnp.asarray([[1.0], [1.0], [0.0], [0.0]]),
+    )
+    np.testing.assert_allclose(m["auc"], 1.0)
+    np.testing.assert_allclose(m["precision"], 1.0)
+    np.testing.assert_allclose(m["recall"], 1.0)
+
+    # Degenerate single-class batch: AUC is chance, recall defined, the
+    # zero-denominator metrics are 0 (tf.metrics behavior).
+    m = head.eval_metrics(
+        jnp.asarray([[-1.0], [-2.0]]), jnp.asarray([[0.0], [0.0]])
+    )
+    np.testing.assert_allclose(m["auc"], 0.5)
+    np.testing.assert_allclose(m["precision"], 0.0)
+    np.testing.assert_allclose(m["recall"], 0.0)
+
+
+def test_binary_auc_handles_ties():
+    from adanet_tpu.core.heads import _binary_auc
+
+    # All scores tied: every pos/neg pair counts half -> 0.5.
+    np.testing.assert_allclose(
+        float(_binary_auc(jnp.full((4,), 0.7), jnp.asarray([1, 0, 1, 0.0]))),
+        0.5,
+    )
+
+
+def test_binary_auc_matches_pairwise_oracle():
+    """The O(n log n) rank formulation must equal the all-pairs statistic
+    (with ties and weights)."""
+    from adanet_tpu.core.heads import _binary_auc
+
+    rng = np.random.RandomState(0)
+    p = rng.choice([0.1, 0.3, 0.3, 0.7, 0.9], size=64)
+    y = rng.randint(0, 2, size=64).astype(np.float32)
+    w = rng.uniform(0.0, 2.0, size=64).astype(np.float32)
+
+    def pairwise(p, y, w):
+        num = den = 0.0
+        for i in range(len(p)):
+            for j in range(len(p)):
+                if y[i] > 0.5 and y[j] <= 0.5:
+                    pair_w = w[i] * w[j]
+                    den += pair_w
+                    if p[i] > p[j]:
+                        num += pair_w
+                    elif p[i] == p[j]:
+                        num += 0.5 * pair_w
+        return num / den
+
+    np.testing.assert_allclose(
+        float(_binary_auc(jnp.asarray(p), jnp.asarray(y))),
+        pairwise(p, y, np.ones_like(w)),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(_binary_auc(jnp.asarray(p), jnp.asarray(y), jnp.asarray(w))),
+        pairwise(p, y, w),
+        rtol=1e-5,
+    )
+
+
+def test_binary_metrics_respect_weights():
+    """Zero-weighted (masked) examples must not leak into any metric."""
+    head = BinaryClassificationHead()
+    logits = jnp.asarray([[2.0], [-1.0], [5.0], [-5.0]])
+    labels = jnp.asarray([[1.0], [0.0], [0.0], [1.0]])
+    weights = jnp.asarray([[1.0], [1.0], [0.0], [0.0]])
+    m = head.eval_metrics(logits, labels, weights)
+    sub = head.eval_metrics(logits[:2], labels[:2])
+    for key in ("accuracy", "auc", "precision", "recall", "label/mean"):
+        np.testing.assert_allclose(m[key], sub[key], rtol=1e-6)
+
+
+def test_multiclass_top_k_accuracy():
+    head = MultiClassHead(n_classes=10)  # top_k defaults to 5
+    logits = np.zeros((2, 10), np.float32)
+    logits[0, :5] = [5, 4, 3, 2, 1]  # label 4 ranks 5th -> in top-5
+    logits[1, :6] = [6, 5, 4, 3, 2, 1]  # label 9: 6 strictly larger -> out
+    m = head.eval_metrics(jnp.asarray(logits), jnp.asarray([4, 9]))
+    np.testing.assert_allclose(m["accuracy"], 0.0)
+    np.testing.assert_allclose(m["top_5_accuracy"], 0.5)
+
+    # Small-class heads skip top-k; explicit k overrides.
+    assert "top_5_accuracy" not in MultiClassHead(3).eval_metrics(
+        jnp.zeros((1, 3)), jnp.asarray([0])
+    )
+    m = MultiClassHead(4, top_k=2).eval_metrics(
+        jnp.asarray([[1.0, 2.0, 3.0, 4.0]]), jnp.asarray([2])
+    )
+    np.testing.assert_allclose(m["top_2_accuracy"], 1.0)
+    with pytest.raises(ValueError):
+        MultiClassHead(4, top_k=4)
+
+
 def test_multiclass_head_requires_two_classes():
     with pytest.raises(ValueError):
         MultiClassHead(n_classes=1)

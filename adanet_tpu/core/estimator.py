@@ -197,6 +197,11 @@ class Estimator:
             ensemblers=self._ensemblers,
             ensemble_strategies=self._strategies,
             adanet_loss_decay=self._adanet_loss_decay,
+            # Hook tensors are traced out of the step when summaries are
+            # off or never written (log_every_steps=0).
+            collect_summaries=(
+                self._enable_summaries and self._log_every_steps > 0
+            ),
         )
 
     # ------------------------------------------------------------ properties
@@ -391,7 +396,7 @@ class Estimator:
                         {k: round(v, 6) for k, v in emas.items()},
                     )
                     self._write_train_summaries(
-                        iteration, metrics, emas, info.global_step
+                        iteration, metrics, emas, info.global_step, state
                     )
                 if (
                     self._save_checkpoint_steps
@@ -466,14 +471,20 @@ class Estimator:
                     % jax.tree_util.keystr(path)
                 )
 
-    def _write_train_summaries(self, iteration, metrics, emas, global_step):
-        """Scoped per-candidate TensorBoard scalars.
+    def _write_train_summaries(
+        self, iteration, metrics, emas, global_step, state=None
+    ):
+        """Scoped per-candidate TensorBoard summaries.
 
         Layout mirrors the reference's candidate-scoped event dirs
         (reference: adanet/core/summary.py:213-373,
         docs/source/tensorboard.md): <model_dir>/ensemble/<name> and
         <model_dir>/subnetwork/<name>, with unscoped tags so identically
-        named metrics overlay across candidates.
+        named metrics overlay across candidates. Beyond scalars this
+        writes mixture-weight histograms per ensemble (the reference's
+        weight summaries, adanet/ensemble/weighted.py:581-594) and any
+        tensors from `Builder.build_subnetwork_summaries` (scalars as
+        scalars, arrays as histograms).
         """
         if not self._enable_summaries:
             return
@@ -492,14 +503,44 @@ class Estimator:
                 {k: v for k, v in values.items() if v is not None},
                 global_step,
             )
+            if state is not None:
+                params = state.ensembles[spec.name].params
+                leaves = jax.tree_util.tree_leaves(params)
+                if leaves:
+                    flat = np.concatenate(
+                        [
+                            np.asarray(jax.device_get(leaf)).reshape(-1)
+                            for leaf in leaves
+                        ]
+                    )
+                    self._summary.histogram(
+                        "ensemble",
+                        spec.name,
+                        "mixture_weights",
+                        flat,
+                        global_step,
+                    )
         for spec in iteration.subnetwork_specs:
+            scope = "t%d_%s" % (iteration.iteration_number, spec.name)
+            scalars = {}
             loss = host.get("subnetwork_loss/%s" % spec.name)
             if loss is not None:
+                scalars["loss"] = loss
+            prefix = "summary/%s/" % spec.name
+            for key, value in host.items():
+                if not key.startswith(prefix):
+                    continue
+                tag = key[len(prefix):]
+                arr = np.asarray(value)
+                if arr.ndim == 0:
+                    scalars[tag] = arr
+                else:
+                    self._summary.histogram(
+                        "subnetwork", scope, tag, arr, global_step
+                    )
+            if scalars:
                 self._summary.scalars(
-                    "subnetwork",
-                    "t%d_%s" % (iteration.iteration_number, spec.name),
-                    {"loss": loss},
-                    global_step,
+                    "subnetwork", scope, scalars, global_step
                 )
         self._summary.flush()
 

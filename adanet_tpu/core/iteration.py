@@ -145,6 +145,7 @@ class Iteration:
         head,
         adanet_loss_decay: float = 0.9,
         previous_ensemble: Optional[FrozenEnsemble] = None,
+        collect_summaries: bool = True,
     ):
         if not ensemble_specs:
             raise ValueError("An iteration needs at least one ensemble spec.")
@@ -154,6 +155,9 @@ class Iteration:
         self.frozen_subnetworks = list(frozen_subnetworks)
         self.head = head
         self.adanet_loss_decay = float(adanet_loss_decay)
+        # When False, builder summary hooks are traced out of the jitted
+        # step entirely (no wasted device compute when nothing is written).
+        self.collect_summaries = bool(collect_summaries)
         self.previous_ensemble = previous_ensemble
         self._spec_by_name = {s.name: s for s in self.ensemble_specs}
 
@@ -516,6 +520,21 @@ class Iteration:
                 jax.random.fold_in(step_rng, i),
                 loss_context=spec_context,
             )
+            # Builder-visible summary hook (scalars/histograms charted
+            # under the candidate's namespace; the reference's scoped
+            # `summary` argument, adanet/core/summary.py:41-199). Called
+            # with the forward that was trained — the subnetwork's own
+            # (possibly bagged) batch — and gated off entirely when the
+            # engine has nowhere to write summaries.
+            if self.collect_summaries:
+                hook = getattr(
+                    spec.builder, "build_subnetwork_summaries", None
+                )
+                extra = (
+                    hook(out, own_features, own_labels) if hook else None
+                )
+                for tag, value in (extra or {}).items():
+                    metrics["summary/%s/%s" % (spec.name, tag)] = value
             if spec.name in extra_batches:
                 # Recompute the forward on the shared batch for ensembles.
                 out, _ = self._apply_subnetwork(
@@ -739,6 +758,7 @@ class IterationBuilder:
         ensemblers: Sequence[Any],
         ensemble_strategies: Sequence[Any],
         adanet_loss_decay: float = 0.9,
+        collect_summaries: bool = True,
     ):
         if not ensemblers:
             raise ValueError("At least one ensembler is required.")
@@ -748,6 +768,7 @@ class IterationBuilder:
         self._ensemblers = list(ensemblers)
         self._strategies = list(ensemble_strategies)
         self._adanet_loss_decay = float(adanet_loss_decay)
+        self._collect_summaries = bool(collect_summaries)
 
     def _ensembler_by_name(self, name: str):
         for ensembler in self._ensemblers:
@@ -875,5 +896,6 @@ class IterationBuilder:
             frozen_subnetworks=frozen_members,
             head=self._head,
             adanet_loss_decay=self._adanet_loss_decay,
+            collect_summaries=self._collect_summaries,
             previous_ensemble=previous_ensemble,
         )

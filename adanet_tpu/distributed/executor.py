@@ -82,14 +82,28 @@ class RoundRobinExecutor:
         self._sub_prev_params = {}
 
         # Per-subnetwork jitted step: forward/backward/update on its submesh.
+        def hook_summaries(spec, out, features, labels):
+            """Builder summary hook, traced out when summaries are off —
+            same semantics as the fused path (iteration._train_step_impl)."""
+            if not iteration.collect_summaries:
+                return {}
+            hook = getattr(spec.builder, "build_subnetwork_summaries", None)
+            extra = hook(out, features, labels) if hook else None
+            return {
+                "summary/%s/%s" % (spec.name, tag): value
+                for tag, value in (extra or {}).items()
+            }
+
         def make_sub_step(spec, with_context):
             if not with_context:
 
                 def step(st, features, labels, rng):
-                    new_st, _, loss = iteration.subnetwork_update(
+                    new_st, out, loss = iteration.subnetwork_update(
                         spec, st, features, labels, rng
                     )
-                    return new_st, loss
+                    return new_st, loss, hook_summaries(
+                        spec, out, features, labels
+                    )
 
                 return jax.jit(step, donate_argnums=0)
 
@@ -102,10 +116,12 @@ class RoundRobinExecutor:
                 context = iteration.build_loss_context(
                     prev_params, frozen_outs
                 )
-                new_st, _, loss = iteration.subnetwork_update(
+                new_st, out, loss = iteration.subnetwork_update(
                     spec, st, features, labels, rng, loss_context=context
                 )
-                return new_st, loss
+                return new_st, loss, hook_summaries(
+                    spec, out, features, labels
+                )
 
             return jax.jit(step_with_context, donate_argnums=0)
 
@@ -220,7 +236,7 @@ class RoundRobinExecutor:
                         "builders use custom losses with a previous "
                         "ensemble (teacher copies live per submesh)."
                     )
-                new_st, loss = self._sub_steps[spec.name](
+                new_st, loss, extra = self._sub_steps[spec.name](
                     state.subnetworks[spec.name],
                     self._sub_frozen[spec.name],
                     self._sub_prev_params[spec.name],
@@ -229,7 +245,7 @@ class RoundRobinExecutor:
                     rng_i,
                 )
             else:
-                new_st, loss = self._sub_steps[spec.name](
+                new_st, loss, extra = self._sub_steps[spec.name](
                     state.subnetworks[spec.name],
                     sub_batch[0],
                     sub_batch[1],
@@ -237,6 +253,7 @@ class RoundRobinExecutor:
                 )
             new_subnetworks[spec.name] = new_st
             metrics["subnetwork_loss/%s" % spec.name] = loss
+            metrics.update(extra)
 
         # Host-side counter avoids a device sync in the dispatch loop.
         step_index = self._host_step

@@ -128,6 +128,24 @@ class Builder(abc.ABC):
         del subnetwork, labels, head, context
         return None
 
+    def build_subnetwork_summaries(self, subnetwork, features, labels):
+        """Optional per-step summary tensors for this subnetwork.
+
+        The functional analogue of the reference passing a scoped `summary`
+        object into `build_subnetwork` so user code can emit
+        scalar/histogram summaries that chart under the candidate's
+        namespace (reference: adanet/subnetwork/generator.py:161-270 and
+        adanet/core/summary.py:41-199). Runs INSIDE the jitted train step.
+
+        Returns:
+          A dict of tag to array, or None. Scalars are written as scalar
+          summaries, higher-rank arrays as histograms, under
+          `<model_dir>/subnetwork/t<t>_<name>/` at the estimator's
+          `log_every_steps` cadence.
+        """
+        del subnetwork, features, labels
+        return None
+
 
 class Generator(abc.ABC):
     """Interface for generating the candidate pool each iteration.

@@ -46,6 +46,11 @@ from adanet_tpu.core.report_materializer import ReportMaterializer
 from adanet_tpu.core.summary import ScopedSummary
 from adanet_tpu.distributed import coordination
 from adanet_tpu.distributed.executor import RoundRobinExecutor
+from adanet_tpu.distributed.mesh import (
+    data_parallel_mesh,
+    global_batch,
+    replicate_state,
+)
 from adanet_tpu.distributed.placement import RoundRobinStrategy
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
@@ -178,6 +183,9 @@ class Estimator:
         # feature/label NaN asserts (reference: estimator.py:386-439).
         self._debug = bool(debug)
         self._iteration_cache: Optional[Iteration] = None
+        # Process-spanning mesh for multi-host SPMD; set by train() when
+        # jax.process_count() > 1.
+        self._spmd_mesh = None
         # Include per-member outputs in predictions (reference ctor flags
         # export_subnetwork_logits/export_subnetwork_last_layer,
         # estimator.py:604-759).
@@ -241,6 +249,29 @@ class Estimator:
                 raise ValueError("Set at most one of steps and max_steps.")
             max_steps = self.latest_global_step() + steps
 
+        # Multi-host SPMD data path (the analogue of the reference's
+        # multi-worker data parallelism, adanet/docs/source/distributed.md:
+        # 6-27): with several JAX processes, every process runs the same
+        # jitted programs over one process-spanning mesh. Each process
+        # feeds its local shard of the global batch; XLA inserts the
+        # gradient all-reduces over ICI/DCN. Filesystem writes stay
+        # chief-only; the manifest handshake is the iteration barrier.
+        if jax.process_count() > 1:
+            if self._placement_strategy is not None:
+                raise ValueError(
+                    "RoundRobin placement is in-process candidate "
+                    "parallelism; with multiple JAX processes use the "
+                    "default placement (multi-host SPMD data parallelism)."
+                )
+            self._spmd_mesh = data_parallel_mesh()
+            _LOG.info(
+                "Multi-host SPMD: %d processes, %d global devices.",
+                jax.process_count(),
+                len(jax.devices()),
+            )
+        else:
+            self._spmd_mesh = None
+
         info = ckpt_lib.read_manifest(self._model_dir) or ckpt_lib.CheckpointInfo()
         data_iter: Optional[Iterator] = None
         # In-memory winner of the previous loop pass; avoids replaying the
@@ -248,6 +279,23 @@ class Estimator:
         # restart, i.e. the first pass).
         cached_previous: Optional[FrozenEnsemble] = None
 
+        try:
+            self._train_loop(
+                input_fn, max_steps, info, data_iter, cached_previous
+            )
+        finally:
+            # Post-training evaluate()/predict() are per-process local
+            # programs (the frozen winner restores from disk as host
+            # arrays); during the search, global metrics come from the
+            # Evaluator, which trains-time code routes through the mesh.
+            # Leaving the mesh set would silently turn public eval calls
+            # into collectives that hang unless every process joins.
+            self._spmd_mesh = None
+        return self
+
+    def _train_loop(
+        self, input_fn, max_steps, info, data_iter, cached_previous
+    ):
         while True:
             t = info.iteration_number
             if self._max_iterations is not None and t >= self._max_iterations:
@@ -292,6 +340,11 @@ class Estimator:
                     "Per-candidate train_input_fn (bagging) is not "
                     "supported with RoundRobinStrategy placement; use the "
                     "default replicated placement."
+                )
+            if self._spmd_mesh is not None and extra_input_fns:
+                raise ValueError(
+                    "Per-candidate train_input_fn (bagging) is not "
+                    "supported with multi-host SPMD training."
                 )
 
             steps_done = int(jax.device_get(state.iteration_step))
@@ -351,14 +404,14 @@ class Estimator:
                             lambda *xs: np.stack(xs), *batches
                         )
                         state, metrics = iteration.train_steps(
-                            state, stacked
+                            state, self._place_batch(stacked, stacked=True)
                         )
                     else:
                         # Ragged batch in the window (e.g. a short final
                         # batch): fall back to single steps.
                         for batch in batches:
                             state, metrics = iteration.train_step(
-                                state, batch
+                                state, self._place_batch(batch)
                             )
                     steps_done += loop_size
                     info.global_step += loop_size
@@ -370,7 +423,7 @@ class Estimator:
                             self._next_batch(fn, extra_iters.get(name))
                         )
                     state, metrics = iteration.train_step(
-                        state, batch, extra_batches
+                        state, self._place_batch(batch), extra_batches
                     )
                     steps_done += 1
                     info.global_step += 1
@@ -424,7 +477,31 @@ class Estimator:
                     self._save_iteration_state(info, t, state)
                 break
 
-            if coordination.is_chief():
+            if self._spmd_mesh is not None:
+                # SPMD bookkeeping: selection/eval/freeze are collective
+                # programs over the process-spanning mesh, so EVERY
+                # process runs them in lockstep (deterministic, identical
+                # results); only the chief persists artifacts. Non-chiefs
+                # then sync on the manifest so no process runs ahead of
+                # durable state (the reference's worker wait,
+                # estimator.py:951-984).
+                # The sample batch is placed globally so freeze-time
+                # forwards (complexity/shared records) are collective and
+                # identical on every process.
+                cached_previous = self._complete_iteration(
+                    iteration,
+                    state,
+                    self._place_batch(sample_batch),
+                    info,
+                    write=coordination.is_chief(),
+                )
+                if not coordination.is_chief():
+                    coordination.wait_for_iteration(
+                        self._model_dir,
+                        t + 1,
+                        timeout_secs=self._worker_wait_timeout_secs,
+                    )
+            elif coordination.is_chief():
                 cached_previous = self._complete_iteration(
                     iteration, state, sample_batch, info
                 )
@@ -437,8 +514,6 @@ class Estimator:
                     timeout_secs=self._worker_wait_timeout_secs,
                 )
                 cached_previous = None
-
-        return self
 
     def _next_batch(self, input_fn, data_iter):
         if data_iter is None:
@@ -490,7 +565,24 @@ class Estimator:
             return
         if self._summary is None:
             self._summary = ScopedSummary(self._model_dir)
-        host = jax.device_get(metrics)
+
+        def host_local(value):
+            # Under multi-host SPMD, batch-shaped hook arrays are sharded
+            # across non-addressable devices; histogram the local shard
+            # instead of crashing (scalars are replicated and fetch fine).
+            if (
+                isinstance(value, jax.Array)
+                and not value.is_fully_addressable
+            ):
+                return np.concatenate(
+                    [
+                        np.asarray(shard.data).reshape(-1)
+                        for shard in value.addressable_shards
+                    ]
+                )
+            return jax.device_get(value)
+
+        host = {key: host_local(value) for key, value in metrics.items()}
         for spec in iteration.ensemble_specs:
             values = {
                 "adanet_loss": host.get("adanet_loss/%s" % spec.name),
@@ -703,6 +795,12 @@ class Estimator:
             prev = frozen
         return prev
 
+    def _place_batch(self, batch, stacked: bool = False):
+        """Routes a host batch onto the SPMD mesh (identity single-host)."""
+        if self._spmd_mesh is None:
+            return batch
+        return global_batch(batch, self._spmd_mesh, stacked=stacked)
+
     def _init_or_restore_state(self, iteration, sample_batch, info):
         state = iteration.init_state(
             self._iteration_rng(iteration.iteration_number), sample_batch
@@ -715,6 +813,11 @@ class Estimator:
                 "Restored mid-iteration state from %s",
                 info.iteration_state_file,
             )
+        if self._spmd_mesh is not None:
+            # Replicate over the process-spanning mesh. Initialization is
+            # deterministic (same seed, same shapes on every process), so
+            # each process contributes an identical value.
+            state = replicate_state(state, self._spmd_mesh)
         return state
 
     def _save_iteration_state(self, info, iteration_number, state) -> None:
@@ -754,7 +857,9 @@ class Estimator:
         # candidate raises rather than being silently frozen as the winner.
         exclude_first = self._force_grow and t > 0
         if self._evaluator:
-            values = self._evaluator.evaluate(iteration, state)
+            values = self._evaluator.evaluate(
+                iteration, state, batch_transform=self._place_batch
+            )
             objective_fn = self._evaluator.objective_fn
             if exclude_first:
                 return int(objective_fn(values[1:])) + 1
@@ -763,7 +868,16 @@ class Estimator:
             state, exclude_first=exclude_first
         )
 
-    def _complete_iteration(self, iteration, state, sample_batch, info):
+    def _complete_iteration(
+        self, iteration, state, sample_batch, info, write: bool = True
+    ):
+        """Selection + freeze + (when `write`) durable artifacts.
+
+        Under multi-host SPMD every process calls this with `write` only
+        on the chief: the computations are collective and deterministic,
+        so all processes reach the same winner, while artifacts are
+        persisted once.
+        """
         t = iteration.iteration_number
         best_index = self._get_best_ensemble_index(iteration, state)
         spec = iteration.ensemble_specs[best_index]
@@ -778,16 +892,19 @@ class Estimator:
         frozen.architecture.add_replay_index(best_index)
         frozen.architecture.set_global_step(info.global_step)
 
-        with open(
-            os.path.join(self._model_dir, ckpt_lib.architecture_filename(t)),
-            "w",
-        ) as f:
-            f.write(frozen.architecture.serialize())
-        payload = ckpt_lib.frozen_to_payload(frozen)
-        payload["name"] = frozen.name
-        ckpt_lib.save_payload(
-            self._model_dir, ckpt_lib.frozen_filename(t), payload
-        )
+        if write:
+            with open(
+                os.path.join(
+                    self._model_dir, ckpt_lib.architecture_filename(t)
+                ),
+                "w",
+            ) as f:
+                f.write(frozen.architecture.serialize())
+            payload = ckpt_lib.frozen_to_payload(frozen)
+            payload["name"] = frozen.name
+            ckpt_lib.save_payload(
+                self._model_dir, ckpt_lib.frozen_filename(t), payload
+            )
 
         if self._report_materializer:
             included = [
@@ -795,19 +912,25 @@ class Estimator:
                 for ws in frozen.weighted_subnetworks
                 if ws.subnetwork.iteration_number == t
             ]
+            # Collective compute on every process; chief-only write.
             reports = (
                 self._report_materializer.materialize_subnetwork_reports(
-                    iteration, state, included
+                    iteration,
+                    state,
+                    included,
+                    batch_transform=self._place_batch,
                 )
             )
-            self._report_accessor.write_iteration_report(t, reports)
+            if write:
+                self._report_accessor.write_iteration_report(t, reports)
 
         stale_state = info.iteration_state_file
         info.iteration_number = t + 1
         info.iteration_state_file = None
         info.replay_indices = frozen.architecture.replay_indices
-        ckpt_lib.write_manifest(self._model_dir, info)
-        self._remove_state_file(stale_state)
+        if write:
+            ckpt_lib.write_manifest(self._model_dir, info)
+            self._remove_state_file(stale_state)
         if self._summary is not None:
             # Scopes are per-iteration (t<N>_...); close them so open file
             # handles stay bounded across long searches.
@@ -926,8 +1049,10 @@ class Estimator:
         # must not be over-weighted; ADVICE round 1).
         acc = WeightedMeanAccumulator()
         for features, labels in self._eval_batches(data, steps):
+            n = batch_example_count((features, labels))
+            features, labels = self._place_batch((features, labels))
             host = jax.device_get(metrics_fn(params, features, labels))
-            acc.add(host, batch_example_count((features, labels)))
+            acc.add(host, n)
         result = acc.means()
         self._write_eval_summaries({name: result}, self.latest_global_step())
         result["best_ensemble"] = name
@@ -974,7 +1099,7 @@ class Estimator:
         accs = {n: WeightedMeanAccumulator() for n in names}
         for batch in self._eval_batches(data, steps):
             size = batch_example_count(batch)
-            results = iteration.eval_step(state, batch)
+            results = iteration.eval_step(state, self._place_batch(batch))
             host = jax.device_get({n: results[n] for n in names})
             for n in names:
                 accs[n].add(host[n], size)

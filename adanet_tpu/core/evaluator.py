@@ -73,12 +73,19 @@ class Evaluator:
             return np.nanargmin
         return np.nanargmax
 
-    def evaluate(self, iteration, state) -> List[float]:
+    def evaluate(self, iteration, state, batch_transform=None) -> List[float]:
         """Mean metric per candidate, in `iteration.candidate_names()` order.
 
         Per-batch means are weighted by example count so a ragged final
         batch does not skew candidate scores (the reference streams
         example-weighted means, reference: adanet/core/evaluator.py:97-140).
+
+        Args:
+          batch_transform: optional callable placing each host batch (the
+            Estimator passes its SPMD global-batch placer under multi-host
+            training, where this evaluation is a collective program every
+            process must run in lockstep — input_fns must then yield the
+            same number of identically-shaped local batches per process).
         """
         names = iteration.candidate_names()
         acc = WeightedMeanAccumulator()
@@ -86,6 +93,8 @@ class Evaluator:
             if self._steps is not None and acc.batches >= self._steps:
                 break
             n = batch_example_count(batch)
+            if batch_transform is not None:
+                batch = batch_transform(batch)
             results = iteration.eval_step(state, batch)
             host = jax.device_get({name: results[name] for name in names})
             acc.add(

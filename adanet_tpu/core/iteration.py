@@ -469,6 +469,25 @@ class Iteration:
             new_cstate = cstate
         return new_est, new_cstate, adanet_loss, loss
 
+    def builder_summary_metrics(self, spec, out, features, labels):
+        """Metrics from `Builder.build_subnetwork_summaries` (inside jit).
+
+        The reference's scoped `summary` argument re-cast functionally
+        (reference: adanet/core/summary.py:41-199): scalars chart as
+        scalars, arrays as histograms, under the candidate's namespace.
+        Shared by the fused step and the RoundRobin executor so the key
+        format and gating cannot diverge; traced out entirely when
+        `collect_summaries` is off.
+        """
+        if not self.collect_summaries:
+            return {}
+        hook = getattr(spec.builder, "build_subnetwork_summaries", None)
+        extra = hook(out, features, labels) if hook else None
+        return {
+            "summary/%s/%s" % (spec.name, tag): value
+            for tag, value in (extra or {}).items()
+        }
+
     def _train_step_impl(self, state: IterationState, batch, extra_batches):
         features, labels = batch
         rng, step_rng = jax.random.split(state.rng)
@@ -520,21 +539,13 @@ class Iteration:
                 jax.random.fold_in(step_rng, i),
                 loss_context=spec_context,
             )
-            # Builder-visible summary hook (scalars/histograms charted
-            # under the candidate's namespace; the reference's scoped
-            # `summary` argument, adanet/core/summary.py:41-199). Called
-            # with the forward that was trained — the subnetwork's own
-            # (possibly bagged) batch — and gated off entirely when the
-            # engine has nowhere to write summaries.
-            if self.collect_summaries:
-                hook = getattr(
-                    spec.builder, "build_subnetwork_summaries", None
+            # Builder-visible summary hook, called with the forward that
+            # was trained — the subnetwork's own (possibly bagged) batch.
+            metrics.update(
+                self.builder_summary_metrics(
+                    spec, out, own_features, own_labels
                 )
-                extra = (
-                    hook(out, own_features, own_labels) if hook else None
-                )
-                for tag, value in (extra or {}).items():
-                    metrics["summary/%s/%s" % (spec.name, tag)] = value
+            )
             if spec.name in extra_batches:
                 # Recompute the forward on the shared batch for ensembles.
                 out, _ = self._apply_subnetwork(
@@ -713,19 +724,23 @@ class Iteration:
                 # (e.g. simple_dnn reading previous depth from `shared`);
                 # jitted so freezing doesn't fall back to op-by-op eager
                 # execution of the whole subnetwork.
+                # Fetch only the replicated record fields — under
+                # multi-host SPMD the batch-shaped outputs (last_layer,
+                # logits) span non-addressable devices and must not be
+                # device_get here.
                 out = jax.jit(
-                    lambda v, f, m=spec.module: m.apply(
-                        v, f, training=False
-                    )
+                    lambda v, f, m=spec.module: (
+                        lambda s: (s.complexity, s.shared)
+                    )(m.apply(v, f, training=False))
                 )(device_variables, features)
-                out = jax.device_get(out)
+                complexity, shared = jax.device_get(out)
                 frozen = FrozenSubnetwork(
                     iteration_number=self.iteration_number,
                     name=spec.name,
                     module=spec.module,
                     params=jax.device_get(device_variables),
-                    complexity=out.complexity,
-                    shared=out.shared,
+                    complexity=complexity,
+                    shared=shared,
                 )
             weight = None
             if weights is not None and i < len(weights):

@@ -43,6 +43,7 @@ class ReportMaterializer:
         iteration,
         state,
         included_subnetwork_names: Sequence[str],
+        batch_transform=None,
     ) -> List[MaterializedReport]:
         """Computes every subnetwork's report metrics over the dataset."""
         reports = {}
@@ -78,6 +79,8 @@ class ReportMaterializer:
             if self._steps is not None and count >= self._steps:
                 break
             n = batch_example_count((features, labels))
+            if batch_transform is not None:
+                features, labels = batch_transform((features, labels))
             host = jax.device_get(jitted(state, features, labels))
             for name, metrics in host.items():
                 accs[name].add(metrics, n)

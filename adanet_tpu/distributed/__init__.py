@@ -15,6 +15,7 @@ from adanet_tpu.distributed.mesh import (
     batch_sharding,
     candidate_submeshes,
     data_parallel_mesh,
+    global_batch,
     partition_devices,
     replicate_state,
     replicated,
@@ -38,6 +39,7 @@ __all__ = [
     "batch_sharding",
     "candidate_submeshes",
     "data_parallel_mesh",
+    "global_batch",
     "partition_devices",
     "replicate_state",
     "replicated",

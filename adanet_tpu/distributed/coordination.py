@@ -9,14 +9,13 @@ default 7200s, estimator.py:951-984).
 
 Multi-host initialization rides `jax.distributed.initialize` (the JAX
 runtime's ICI/DCN bootstrap, replacing the reference's TF_CONFIG gRPC
-cluster). This module is the host-side control plane only. In the current
-Estimator, non-chief processes train independent replicas whose state is
-discarded at iteration boundaries in favor of the chief's checkpoint —
-redundant compute used purely for fault tolerance, weaker than the
-reference's PS aggregation. True multi-host SPMD (global batch sharded
-across processes, gradient psums over ICI/DCN via globally sharded arrays)
-is the planned data path; the mesh/sharding layer in
-`adanet_tpu.distributed.mesh` already expresses it within one process.
+cluster). This module is the host-side control plane; the data plane is
+true multi-host SPMD: with multiple JAX processes, `Estimator.train`
+shards every global batch across processes onto one process-spanning mesh
+(`adanet_tpu.distributed.mesh.global_batch`) and the jitted steps psum
+gradients over ICI/DCN. All processes run the collective bookkeeping
+computations in lockstep; only the chief persists artifacts, and workers
+sync on the manifest (the handshake below).
 """
 
 from __future__ import annotations

@@ -82,17 +82,7 @@ class RoundRobinExecutor:
         self._sub_prev_params = {}
 
         # Per-subnetwork jitted step: forward/backward/update on its submesh.
-        def hook_summaries(spec, out, features, labels):
-            """Builder summary hook, traced out when summaries are off —
-            same semantics as the fused path (iteration._train_step_impl)."""
-            if not iteration.collect_summaries:
-                return {}
-            hook = getattr(spec.builder, "build_subnetwork_summaries", None)
-            extra = hook(out, features, labels) if hook else None
-            return {
-                "summary/%s/%s" % (spec.name, tag): value
-                for tag, value in (extra or {}).items()
-            }
+        hook_summaries = iteration.builder_summary_metrics
 
         def make_sub_step(spec, with_context):
             if not with_context:

@@ -1,0 +1,111 @@
+"""Multi-host SPMD runner: one OS process per JAX process.
+
+Spawned by `test_distributed.py::test_multi_host_spmd_data_path` with a
+shared model_dir, a process id, and a coordinator port — the analogue of
+the reference's TF_CONFIG subprocess grid
+(reference: adanet/core/estimator_distributed_test.py:281-334), but
+exercising REAL cross-process collectives: the two processes form one
+2-device global mesh, each feeds half of every global batch, and the
+Estimator's jitted steps psum gradients across them.
+
+Each process writes `probe_<pid>.npz` with the frozen winner's member
+parameters it computed (the worker computes them with write=False), so the
+test can assert both processes produced identical params AND that they
+match a single-process oracle trained on the concatenated batches —
+evidence the gradient all-reduce actually aggregated both halves.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def full_batches():
+    """Deterministic global batches (16 rows each)."""
+    rng = np.random.RandomState(7)
+    batches = []
+    for _ in range(4):
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x @ np.ones((4, 1), np.float32)) + 0.1
+        batches.append(({"x": x}, y))
+    return batches
+
+
+def main():
+    model_dir, process_id, port = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(
+        coordinator_address="localhost:%s" % port,
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+    assert len(jax.local_devices()) == 1, jax.local_devices()
+
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder
+
+    def local_input_fn():
+        # This process's half of every global batch: rows [0:8] on the
+        # chief, [8:16] on the worker (the global row order of
+        # make_array_from_process_local_data over the 2-device mesh).
+        lo, hi = (0, 8) if process_id == 0 else (8, 16)
+        for features, labels in full_batches():
+            yield {"x": features["x"][lo:hi]}, labels[lo:hi]
+
+    probes = {}
+
+    class ProbeEstimator(adanet_tpu.Estimator):
+        def _complete_iteration(self, iteration, state, *args, **kwargs):
+            frozen = super()._complete_iteration(
+                iteration, state, *args, **kwargs
+            )
+            import jax as _jax
+
+            flat, _ = _jax.tree_util.tree_flatten(
+                [
+                    ws.subnetwork.params
+                    for ws in frozen.weighted_subnetworks
+                ]
+            )
+            for i, leaf in enumerate(flat):
+                probes["t%d_leaf%d" % (frozen.iteration_number, i)] = (
+                    np.asarray(leaf)
+                )
+            return frozen
+
+    est = ProbeEstimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+        ),
+        max_iteration_steps=6,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        max_iterations=2,
+        model_dir=model_dir,
+        log_every_steps=0,
+    )
+    est.train(local_input_fn, max_steps=100)
+    assert est.latest_iteration_number() == 2
+
+    np.savez(
+        os.path.join(model_dir, "probe_%d.npz" % process_id), **probes
+    )
+    print("SPMD ROLE %d DONE" % process_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -316,13 +316,6 @@ class Estimator:
                 executor = RoundRobinExecutor(
                     iteration, self._placement_strategy
                 )
-                if self._iterations_per_loop > 1:
-                    _LOG.warning(
-                        "iterations_per_loop=%d is ignored under "
-                        "RoundRobinStrategy placement (one step per "
-                        "dispatch).",
-                        self._iterations_per_loop,
-                    )
             state = self._init_or_restore_state(iteration, sample_batch, info)
             if executor is not None:
                 state = executor.place(state)
@@ -384,37 +377,46 @@ class Estimator:
                     )
                 loop_size = min(self._iterations_per_loop, steps_budget)
                 prev_steps_done = steps_done
-                if executor is not None:
-                    # Candidate-parallel training: one step per dispatch
-                    # (iterations_per_loop does not apply here; bagging is
-                    # rejected above).
-                    batch, data_iter = self._next_batch(input_fn, data_iter)
-                    state, metrics = executor.train_step(state, batch)
-                    steps_done += 1
-                    info.global_step += 1
-                elif loop_size > 1 and not extra_input_fns:
+                use_window = loop_size > 1 and (
+                    executor is not None or not extra_input_fns
+                )
+                if use_window:
+                    # K steps per dispatch: collect the window, stack it
+                    # when shapes agree (one lax.scan dispatch), and fall
+                    # back to single steps on a ragged window (e.g. a
+                    # short final batch). Shared policy for the fused and
+                    # RoundRobin paths.
                     batches = []
                     for _ in range(loop_size):
                         batch, data_iter = self._next_batch(
                             input_fn, data_iter
                         )
                         batches.append(batch)
+                    if executor is not None:
+                        one_step = executor.train_step
+                        many_steps = lambda s, b: executor.train_steps(s, b)
+                    else:
+                        one_step = lambda s, b: iteration.train_step(
+                            s, self._place_batch(b)
+                        )
+                        many_steps = lambda s, b: iteration.train_steps(
+                            s, self._place_batch(b, stacked=True)
+                        )
                     if _same_shapes(batches):
                         stacked = jax.tree_util.tree_map(
                             lambda *xs: np.stack(xs), *batches
                         )
-                        state, metrics = iteration.train_steps(
-                            state, self._place_batch(stacked, stacked=True)
-                        )
+                        state, metrics = many_steps(state, stacked)
                     else:
-                        # Ragged batch in the window (e.g. a short final
-                        # batch): fall back to single steps.
                         for batch in batches:
-                            state, metrics = iteration.train_step(
-                                state, self._place_batch(batch)
-                            )
+                            state, metrics = one_step(state, batch)
                     steps_done += loop_size
                     info.global_step += loop_size
+                elif executor is not None:
+                    batch, data_iter = self._next_batch(input_fn, data_iter)
+                    state, metrics = executor.train_step(state, batch)
+                    steps_done += 1
+                    info.global_step += 1
                 else:
                     batch, data_iter = self._next_batch(input_fn, data_iter)
                     extra_batches = {}
@@ -569,10 +571,12 @@ class Estimator:
         def host_local(value):
             # Under multi-host SPMD, batch-shaped hook arrays are sharded
             # across non-addressable devices; histogram the local shard
-            # instead of crashing (scalars are replicated and fetch fine).
+            # instead of crashing. Fully-replicated arrays (the scalar
+            # metrics) fetch whole via device_get.
             if (
                 isinstance(value, jax.Array)
                 and not value.is_fully_addressable
+                and not value.is_fully_replicated
             ):
                 return np.concatenate(
                     [

@@ -54,6 +54,7 @@ class RoundRobinExecutor:
         self.strategy = strategy or RoundRobinStrategy()
         self.sync_every = int(sync_every)
         self._host_step = 0
+        self._last_sync_step = 0
         self._member_vars_cache = None
 
         n = len(iteration.subnetwork_specs)
@@ -120,6 +121,67 @@ class RoundRobinExecutor:
             for spec in iteration.subnetwork_specs
         }
 
+        # Multi-step variants: K steps per dispatch via lax.scan on the
+        # submesh (the RoundRobin realization of `iterations_per_loop`,
+        # reference TPU analogue: adanet/core/iteration.py:872-925).
+        def scan_subnetwork(spec, st, batch, rng, context_args=None):
+            def body(carry, xs):
+                (features, labels), step_rng = xs
+                if context_args is not None:
+                    frozen_params, prev_params = context_args
+                    frozen_outs = iteration.frozen_outputs(
+                        frozen_params, features
+                    )
+                    context = iteration.build_loss_context(
+                        prev_params, frozen_outs
+                    )
+                else:
+                    context = None
+                new_st, out, loss = iteration.subnetwork_update(
+                    spec, carry, features, labels, step_rng,
+                    loss_context=context,
+                )
+                return new_st, (
+                    loss,
+                    iteration.builder_summary_metrics(
+                        spec, out, features, labels
+                    ),
+                )
+
+            k = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            rngs = jax.random.split(rng, k)
+            final, (losses, summaries) = jax.lax.scan(
+                body, st, (batch, rngs)
+            )
+            # Last step's metrics, matching Iteration.train_steps.
+            return final, losses[-1], jax.tree_util.tree_map(
+                lambda x: x[-1], summaries
+            )
+
+        def make_sub_multi_step(spec, with_context):
+            if not with_context:
+
+                def steps(st, batch, rng):
+                    return scan_subnetwork(spec, st, batch, rng)
+
+                return jax.jit(steps, donate_argnums=0)
+
+            def steps_with_context(
+                st, frozen_params, prev_params, batch, rng
+            ):
+                return scan_subnetwork(
+                    spec, st, batch, rng, (frozen_params, prev_params)
+                )
+
+            return jax.jit(steps_with_context, donate_argnums=0)
+
+        self._sub_multi_steps = {
+            spec.name: make_sub_multi_step(
+                spec, self._needs_context[spec.name]
+            )
+            for spec in iteration.subnetwork_specs
+        }
+
         # Ensemble-group jitted step: member forwards (no grads) + every
         # ensemble candidate's mixture-weight update on the ensemble submesh.
         def ens_step(ensembles, candidates, frozen, member_vars, features, labels):
@@ -153,6 +215,28 @@ class RoundRobinExecutor:
             return new_ens, new_cands, metrics
 
         self._ens_step = jax.jit(ens_step, donate_argnums=(0, 1))
+
+        def ens_multi_step(
+            ensembles, candidates, frozen, member_vars, batch
+        ):
+            def body(carry, step_batch):
+                ens, cands = carry
+                features, labels = step_batch
+                new_ens, new_cands, metrics = ens_step(
+                    ens, cands, frozen, member_vars, features, labels
+                )
+                return (new_ens, new_cands), metrics
+
+            (ens, cands), ms = jax.lax.scan(
+                body, (ensembles, candidates), batch
+            )
+            return ens, cands, jax.tree_util.tree_map(
+                lambda x: x[-1], ms
+            )
+
+        self._ens_multi_step = jax.jit(
+            ens_multi_step, donate_argnums=(0, 1)
+        )
 
     # ------------------------------------------------------------------ state
 
@@ -246,17 +330,8 @@ class RoundRobinExecutor:
             metrics.update(extra)
 
         # Host-side counter avoids a device sync in the dispatch loop.
-        step_index = self._host_step
-        self._host_step = step_index + 1
-        if step_index % self.sync_every == 0 or self._member_vars_cache is None:
-            # ICI transfer of member params to the ensemble submesh — the
-            # analogue of PS variable fetches.
-            self._member_vars_cache = {
-                name: mesh_lib.replicate_state(
-                    st.variables, self._ens_mesh
-                )
-                for name, st in new_subnetworks.items()
-            }
+        self._host_step += 1
+        self._maybe_sync_members(new_subnetworks)
 
         ens_batch = mesh_lib.shard_batch((features, labels), self._ens_mesh)
         new_ens, new_cands, ens_metrics = self._ens_step(
@@ -278,6 +353,95 @@ class RoundRobinExecutor:
             rng=rng,
         )
         return new_state, metrics
+
+    def _maybe_sync_members(self, new_subnetworks) -> None:
+        """ICI transfer of member params to the ensemble submesh — the
+        analogue of PS variable fetches — when `sync_every` steps have
+        passed since the last transfer (multi-step windows advance the
+        counter by K, so effective staleness is max(sync_every, K))."""
+        if (
+            self._member_vars_cache is not None
+            and self._host_step - self._last_sync_step < self.sync_every
+        ):
+            return
+        self._last_sync_step = self._host_step
+        self._member_vars_cache = {
+            name: mesh_lib.replicate_state(st.variables, self._ens_mesh)
+            for name, st in new_subnetworks.items()
+        }
+
+    def train_steps(self, state: IterationState, stacked_batch):
+        """K candidate-parallel steps in one dispatch per submesh.
+
+        The RoundRobin realization of `iterations_per_loop`
+        (reference TPU path: adanet/core/iteration.py:872-925 runs N steps
+        per device loop): each subnetwork scans its K steps on its own
+        submesh via `lax.scan`; member params transfer to the ensemble
+        submesh once per window (aligned with `sync_every`), and the
+        ensemble group scans its K mixture-weight updates against those
+        fixed member params. Returns (state, metrics-of-last-step).
+        """
+        features, labels = stacked_batch
+        k = int(jax.tree_util.tree_leaves(features)[0].shape[0])
+        rng, step_rng = jax.random.split(state.rng)
+
+        new_subnetworks = {}
+        metrics = {}
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            sub_mesh = self._sub_meshes[spec.name]
+            sub_batch = mesh_lib.shard_batch(
+                (features, labels), sub_mesh, stacked=True
+            )
+            rng_i = jax.random.fold_in(step_rng, i)
+            if self._needs_context[spec.name]:
+                if spec.name not in self._sub_frozen:
+                    raise ValueError(
+                        "State was not placed: call executor.init_state() "
+                        "or executor.place(state) before train_steps when "
+                        "builders use custom losses with a previous "
+                        "ensemble (teacher copies live per submesh)."
+                    )
+                new_st, loss, extra = self._sub_multi_steps[spec.name](
+                    state.subnetworks[spec.name],
+                    self._sub_frozen[spec.name],
+                    self._sub_prev_params[spec.name],
+                    sub_batch,
+                    rng_i,
+                )
+            else:
+                new_st, loss, extra = self._sub_multi_steps[spec.name](
+                    state.subnetworks[spec.name], sub_batch, rng_i
+                )
+            new_subnetworks[spec.name] = new_st
+            metrics["subnetwork_loss/%s" % spec.name] = loss
+            metrics.update(extra)
+
+        self._host_step += k
+        self._maybe_sync_members(new_subnetworks)
+
+        ens_batch = mesh_lib.shard_batch(
+            (features, labels), self._ens_mesh, stacked=True
+        )
+        new_ens, new_cands, ens_metrics = self._ens_multi_step(
+            state.ensembles,
+            state.candidates,
+            state.frozen,
+            self._member_vars_cache,
+            ens_batch,
+        )
+        metrics.update(ens_metrics)
+
+        return (
+            IterationState(
+                subnetworks=new_subnetworks,
+                ensembles=new_ens,
+                candidates=new_cands,
+                frozen=state.frozen,
+                iteration_step=state.iteration_step + k,
+                rng=rng,
+            ),
+            metrics,
+        )
 
     # ------------------------------------------------------------- gather
 

@@ -68,22 +68,29 @@ def candidate_submeshes(
     ]
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, stacked: bool = False):
     """Device-puts a (features, labels) batch sharded over the data axis.
 
-    Arrays whose leading dimension is not divisible by the mesh's data size
+    Arrays whose batch dimension is not divisible by the mesh's data size
     are replicated instead (XLA requires even sharding); keep batch sizes
     divisible by the submesh size for full data parallelism — the analogue
     of the reference's `drop_remainder` handling
-    (reference: adanet/distributed/placement.py:196-254).
+    (reference: adanet/distributed/placement.py:196-254). With
+    `stacked=True` leaves are [num_steps, batch, ...] multi-step windows
+    and the batch dimension is axis 1.
     """
     data_size = mesh.shape["data"]
-    sharded = batch_sharding(mesh)
+    batch_axis = 1 if stacked else 0
+    spec = [None] * batch_axis + ["data"]
+    sharded = NamedSharding(mesh, PartitionSpec(*spec))
     replica = replicated(mesh)
 
     def put(x):
         arr = np.asarray(x) if not hasattr(x, "shape") else x
-        if arr.ndim >= 1 and arr.shape[0] % data_size == 0:
+        if (
+            arr.ndim > batch_axis
+            and arr.shape[batch_axis] % data_size == 0
+        ):
             return jax.device_put(arr, sharded)
         return jax.device_put(arr, replica)
 
@@ -124,12 +131,25 @@ def global_batch(batch, mesh: Mesh, stacked: bool = False):
         PartitionSpec(None, "data") if stacked else PartitionSpec("data")
     )
     sharding = NamedSharding(mesh, spec)
-    min_rank = 2 if stacked else 1
+    batch_axis = 1 if stacked else 0
+    local_devices = sum(
+        1 for d in mesh.devices.flatten() if d.process_index == jax.process_index()
+    )
 
     def put(x):
         arr = np.asarray(x)
-        if arr.ndim < min_rank:
+        if arr.ndim <= batch_axis:
             return x
+        if local_devices and arr.shape[batch_axis] % local_devices != 0:
+            # Replicating would need identical values on every process,
+            # which per-process data shards cannot guarantee — fail with
+            # an actionable message instead of an opaque XLA error.
+            raise ValueError(
+                "Multi-host SPMD requires the per-process batch dimension "
+                "(%d) to be divisible by the process's %d local devices; "
+                "drop or pad the remainder batch."
+                % (arr.shape[batch_axis], local_devices)
+            )
         return jax.make_array_from_process_local_data(sharding, arr)
 
     return jax.tree_util.tree_map(put, batch)

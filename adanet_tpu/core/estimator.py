@@ -394,7 +394,7 @@ class Estimator:
                         batches.append(batch)
                     if executor is not None:
                         one_step = executor.train_step
-                        many_steps = lambda s, b: executor.train_steps(s, b)
+                        many_steps = executor.train_steps
                     else:
                         one_step = lambda s, b: iteration.train_step(
                             s, self._place_batch(b)

@@ -17,6 +17,15 @@ so candidate EMAs are not bit-identical to the fused single-program path,
 which shares the training-mode forward between subnetwork and ensemble
 losses.
 
+Staleness contract: subnetwork training itself is IDENTICAL to the fused
+path (same batches, same updates). The ensemble's selection signal sees
+member params that are up to `sync_every` steps stale and, at a sync
+boundary, one step AHEAD of the fused path's in-step forward (post-update
+vs pre-update params) — during rapid early descent its adanet_loss reads
+lower, converging to the fused trajectory at plateau. The divergence
+bound is asserted by
+tests/test_distributed.py::test_round_robin_fused_divergence_bounded.
+
 Within each submesh, training is synchronous data parallelism: the batch is
 sharded over the submesh's `data` axis and XLA inserts the gradient
 all-reduce over ICI.
@@ -27,6 +36,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from adanet_tpu.core.iteration import Iteration, IterationState
 from adanet_tpu.distributed import mesh as mesh_lib
@@ -83,35 +93,41 @@ class RoundRobinExecutor:
         self._sub_prev_params = {}
 
         # Per-subnetwork jitted step: forward/backward/update on its submesh.
-        hook_summaries = iteration.builder_summary_metrics
-
-        def make_sub_step(spec, with_context):
-            if not with_context:
-
-                def step(st, features, labels, rng):
-                    new_st, out, loss = iteration.subnetwork_update(
-                        spec, st, features, labels, rng
-                    )
-                    return new_st, loss, hook_summaries(
-                        spec, out, features, labels
-                    )
-
-                return jax.jit(step, donate_argnums=0)
-
-            def step_with_context(
-                st, frozen_params, prev_params, features, labels, rng
-            ):
+        # ONE per-step body per subnetwork, shared by the single-step jit
+        # and the lax.scan window so the two dispatch modes cannot
+        # diverge. `context_args` is () or (frozen_params, prev_params).
+        def step_body(spec, st, features, labels, key, context_args):
+            if context_args:
+                frozen_params, prev_params = context_args
                 frozen_outs = iteration.frozen_outputs(
                     frozen_params, features
                 )
                 context = iteration.build_loss_context(
                     prev_params, frozen_outs
                 )
-                new_st, out, loss = iteration.subnetwork_update(
-                    spec, st, features, labels, rng, loss_context=context
-                )
-                return new_st, loss, hook_summaries(
-                    spec, out, features, labels
+            else:
+                context = None
+            new_st, out, loss = iteration.subnetwork_update(
+                spec, st, features, labels, key, loss_context=context
+            )
+            return new_st, loss, iteration.builder_summary_metrics(
+                spec, out, features, labels
+            )
+
+        def make_sub_step(spec, with_context):
+            if not with_context:
+
+                def step(st, features, labels, key):
+                    return step_body(spec, st, features, labels, key, ())
+
+                return jax.jit(step, donate_argnums=0)
+
+            def step_with_context(
+                st, frozen_params, prev_params, features, labels, key
+            ):
+                return step_body(
+                    spec, st, features, labels, key,
+                    (frozen_params, prev_params),
                 )
 
             return jax.jit(step_with_context, donate_argnums=0)
@@ -121,37 +137,22 @@ class RoundRobinExecutor:
             for spec in iteration.subnetwork_specs
         }
 
-        # Multi-step variants: K steps per dispatch via lax.scan on the
-        # submesh (the RoundRobin realization of `iterations_per_loop`,
+        # Multi-step variants: K steps per dispatch via lax.scan over the
+        # SAME body (the RoundRobin realization of `iterations_per_loop`,
         # reference TPU analogue: adanet/core/iteration.py:872-925).
-        def scan_subnetwork(spec, st, batch, rng, context_args=None):
+        # `keys` are the K pre-folded per-step keys — the exact stream K
+        # single dispatches would use, so windowing never changes the
+        # training trajectory of stochastic builders.
+        def scan_subnetwork(spec, st, batch, keys, context_args):
             def body(carry, xs):
-                (features, labels), step_rng = xs
-                if context_args is not None:
-                    frozen_params, prev_params = context_args
-                    frozen_outs = iteration.frozen_outputs(
-                        frozen_params, features
-                    )
-                    context = iteration.build_loss_context(
-                        prev_params, frozen_outs
-                    )
-                else:
-                    context = None
-                new_st, out, loss = iteration.subnetwork_update(
-                    spec, carry, features, labels, step_rng,
-                    loss_context=context,
+                (features, labels), key = xs
+                new_st, loss, extra = step_body(
+                    spec, carry, features, labels, key, context_args
                 )
-                return new_st, (
-                    loss,
-                    iteration.builder_summary_metrics(
-                        spec, out, features, labels
-                    ),
-                )
+                return new_st, (loss, extra)
 
-            k = jax.tree_util.tree_leaves(batch)[0].shape[0]
-            rngs = jax.random.split(rng, k)
             final, (losses, summaries) = jax.lax.scan(
-                body, st, (batch, rngs)
+                body, st, (batch, keys)
             )
             # Last step's metrics, matching Iteration.train_steps.
             return final, losses[-1], jax.tree_util.tree_map(
@@ -161,16 +162,16 @@ class RoundRobinExecutor:
         def make_sub_multi_step(spec, with_context):
             if not with_context:
 
-                def steps(st, batch, rng):
-                    return scan_subnetwork(spec, st, batch, rng)
+                def steps(st, batch, keys):
+                    return scan_subnetwork(spec, st, batch, keys, ())
 
                 return jax.jit(steps, donate_argnums=0)
 
             def steps_with_context(
-                st, frozen_params, prev_params, batch, rng
+                st, frozen_params, prev_params, batch, keys
             ):
                 return scan_subnetwork(
-                    spec, st, batch, rng, (frozen_params, prev_params)
+                    spec, st, batch, keys, (frozen_params, prev_params)
                 )
 
             return jax.jit(steps_with_context, donate_argnums=0)
@@ -383,7 +384,15 @@ class RoundRobinExecutor:
         """
         features, labels = stacked_batch
         k = int(jax.tree_util.tree_leaves(features)[0].shape[0])
-        rng, step_rng = jax.random.split(state.rng)
+        # Replay the EXACT per-step RNG sequence of K single dispatches
+        # (train_step does `rng, step_rng = split(state.rng)` each call),
+        # so windowed and single-step training are the same trajectory.
+        rng = state.rng
+        step_rngs = []
+        for _ in range(k):
+            rng, step_rng = jax.random.split(rng)
+            step_rngs.append(step_rng)
+        step_rngs = jnp.stack(step_rngs)
 
         new_subnetworks = {}
         metrics = {}
@@ -392,7 +401,9 @@ class RoundRobinExecutor:
             sub_batch = mesh_lib.shard_batch(
                 (features, labels), sub_mesh, stacked=True
             )
-            rng_i = jax.random.fold_in(step_rng, i)
+            keys_i = jax.vmap(
+                lambda key, index=i: jax.random.fold_in(key, index)
+            )(step_rngs)
             if self._needs_context[spec.name]:
                 if spec.name not in self._sub_frozen:
                     raise ValueError(
@@ -406,11 +417,11 @@ class RoundRobinExecutor:
                     self._sub_frozen[spec.name],
                     self._sub_prev_params[spec.name],
                     sub_batch,
-                    rng_i,
+                    keys_i,
                 )
             else:
                 new_st, loss, extra = self._sub_multi_steps[spec.name](
-                    state.subnetworks[spec.name], sub_batch, rng_i
+                    state.subnetworks[spec.name], sub_batch, keys_i
                 )
             new_subnetworks[spec.name] = new_st
             metrics["subnetwork_loss/%s" % spec.name] = loss

@@ -1,0 +1,129 @@
+"""Signature-keyed AOT compile cache: reuse XLA executables across
+iterations.
+
+SURVEY §7 hard part (a): every AdaNet iteration rebuilds its programs, and
+jit's internal cache keys on function identity, so iteration t+1 re-pays
+XLA compilation even for programs structurally identical to iteration t's
+(e.g. the same-architecture candidate steps a `SimpleGenerator` produces
+every round under RoundRobin placement, or a rebuilt iteration after
+restart). The reference never pays this because it keeps one live TF graph
+per iteration.
+
+`CompileCache` closes the gap without any semantic risk: programs are
+keyed by the HASH OF THEIR LOWERED StableHLO (which embeds shapes, dtypes,
+shardings, and donation/aliasing) plus the argument device assignment —
+i.e. two programs share an executable only when XLA would be handed
+byte-identical input on the same devices. Tracing/lowering still runs once
+per program instance (cheap); the XLA optimization pipeline — the
+dominant cost — is skipped on a hit.
+
+`CachedStep` is the call-site wrapper: it behaves like `jax.jit(fn)` but
+routes compilation through a shared `CompileCache`, memoizing the
+executable per argument spec so lowering is also amortized within an
+instance.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _leaf_spec(leaf) -> Tuple:
+    # Raw hashable objects, no repr strings: jax shardings hash their
+    # mesh AND concrete devices, so the spec distinguishes equal-shaped
+    # submeshes on different chips (an executable is device-bound).
+    if isinstance(leaf, jax.Array):
+        return (leaf.shape, leaf.dtype, leaf.sharding)
+    arr = np.asarray(leaf)
+    return (arr.shape, arr.dtype, None)
+
+
+def arg_spec(args) -> Tuple:
+    """Hashable structure/shape/dtype/sharding signature of call args."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple(_leaf_spec(leaf) for leaf in leaves))
+
+
+def _device_fingerprint(args) -> Tuple:
+    """Device assignment of committed args (HLO text omits devices, and an
+    executable is bound to them)."""
+    ids = set()
+    for leaf in jax.tree_util.tree_leaves(args):
+        if isinstance(leaf, jax.Array):
+            for device in leaf.sharding.device_set:
+                ids.add(device.id)
+    return tuple(sorted(ids))
+
+
+class CompileCache:
+    """Shared executable store keyed by (StableHLO hash, devices).
+
+    Bounded LRU: a long search compiles programs that can never hit again
+    (each iteration's ensemble program embeds one more frozen member), so
+    stale entries are evicted beyond `max_entries`. Live `CachedStep`
+    instances keep their own references, so eviction never invalidates an
+    executable in use.
+    """
+
+    def __init__(self, max_entries: int = 128):
+        self._executables = collections.OrderedDict()
+        self._max_entries = int(max_entries)
+        self.hits = 0
+        self.misses = 0
+
+    def compile(self, jitted, *args):
+        """Lower `jitted` for `args`; reuse an executable when the lowered
+        program and device assignment match a previous compile."""
+        lowered = jitted.lower(*args)
+        # The module symbol carries the python function's name
+        # (`module @jit_f`); canonicalize it so identical programs from
+        # differently-named closures (each Iteration builds fresh ones)
+        # hash equal.
+        text = re.sub(
+            r"^module @\S+", "module @m", lowered.as_text(), count=1
+        )
+        digest = hashlib.sha256(text.encode()).hexdigest()
+        key = (digest, _device_fingerprint(args))
+        executable = self._executables.get(key)
+        if executable is None:
+            executable = lowered.compile()
+            self._executables[key] = executable
+            self.misses += 1
+            while len(self._executables) > self._max_entries:
+                self._executables.popitem(last=False)
+        else:
+            self._executables.move_to_end(key)
+            self.hits += 1
+        return executable
+
+    def clear(self) -> None:
+        self._executables.clear()
+
+
+class CachedStep:
+    """A jit-like callable whose compilation goes through a CompileCache.
+
+    With `cache=None` it degrades to plain `jax.jit` (zero overhead for
+    users who do not opt in).
+    """
+
+    def __init__(self, fn, cache: Optional[CompileCache], donate_argnums=()):
+        self._jit = jax.jit(fn, donate_argnums=donate_argnums)
+        self._cache = cache
+        self._by_spec: Dict[Tuple, Any] = {}
+
+    def __call__(self, *args):
+        if self._cache is None:
+            return self._jit(*args)
+        spec = arg_spec(args)
+        executable = self._by_spec.get(spec)
+        if executable is None:
+            executable = self._cache.compile(self._jit, *args)
+            self._by_spec[spec] = executable
+        return executable(*args)

@@ -34,6 +34,7 @@ import numpy as np
 
 from adanet_tpu.core import checkpoint as ckpt_lib
 from adanet_tpu.core.architecture import Architecture
+from adanet_tpu.core.compile_cache import CompileCache
 from adanet_tpu.core.evaluator import Evaluator
 from adanet_tpu.core.frozen import (
     FrozenEnsemble,
@@ -200,6 +201,11 @@ class Estimator:
         # §1 L5). None = replicated training (the reference default).
         self._placement_strategy = placement_strategy
 
+        # One executable cache for the whole search: iteration t+1's
+        # structurally-identical programs (same-architecture candidates
+        # under RoundRobin, rebuilt iterations after restart) skip XLA
+        # compilation (SURVEY §7 hard part (a)).
+        self._compile_cache = CompileCache()
         self._iteration_builder = IterationBuilder(
             head=head,
             ensemblers=self._ensemblers,
@@ -210,6 +216,7 @@ class Estimator:
             collect_summaries=(
                 self._enable_summaries and self._log_every_steps > 0
             ),
+            compile_cache=self._compile_cache,
         )
 
     # ------------------------------------------------------------ properties

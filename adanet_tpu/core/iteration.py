@@ -33,6 +33,7 @@ import optax
 from flax import struct
 
 from adanet_tpu.core import candidate as candidate_lib
+from adanet_tpu.core.compile_cache import CachedStep
 from adanet_tpu.core.architecture import Architecture
 from adanet_tpu.core.frozen import (
     FrozenEnsemble,
@@ -146,6 +147,7 @@ class Iteration:
         adanet_loss_decay: float = 0.9,
         previous_ensemble: Optional[FrozenEnsemble] = None,
         collect_summaries: bool = True,
+        compile_cache=None,
     ):
         if not ensemble_specs:
             raise ValueError("An iteration needs at least one ensemble spec.")
@@ -161,11 +163,16 @@ class Iteration:
         self.previous_ensemble = previous_ensemble
         self._spec_by_name = {s.name: s for s in self.ensemble_specs}
 
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
-        self._train_multi_step = jax.jit(
-            self._train_multi_step_impl, donate_argnums=0
+        # Signature-keyed executable reuse across rebuilt iterations
+        # (SURVEY §7 hard part (a)); None = plain jit.
+        self.compile_cache = compile_cache
+        self._train_step = CachedStep(
+            self._train_step_impl, compile_cache, donate_argnums=0
         )
-        self._eval_step = jax.jit(self._eval_step_impl)
+        self._train_multi_step = CachedStep(
+            self._train_multi_step_impl, compile_cache, donate_argnums=0
+        )
+        self._eval_step = CachedStep(self._eval_step_impl, compile_cache)
 
     # ------------------------------------------------------------------ init
 
@@ -774,6 +781,7 @@ class IterationBuilder:
         ensemble_strategies: Sequence[Any],
         adanet_loss_decay: float = 0.9,
         collect_summaries: bool = True,
+        compile_cache=None,
     ):
         if not ensemblers:
             raise ValueError("At least one ensembler is required.")
@@ -784,6 +792,7 @@ class IterationBuilder:
         self._strategies = list(ensemble_strategies)
         self._adanet_loss_decay = float(adanet_loss_decay)
         self._collect_summaries = bool(collect_summaries)
+        self._compile_cache = compile_cache
 
     def _ensembler_by_name(self, name: str):
         for ensembler in self._ensemblers:
@@ -912,5 +921,6 @@ class IterationBuilder:
             head=self._head,
             adanet_loss_decay=self._adanet_loss_decay,
             collect_summaries=self._collect_summaries,
+            compile_cache=self._compile_cache,
             previous_ensemble=previous_ensemble,
         )

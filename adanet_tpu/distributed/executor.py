@@ -38,6 +38,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from adanet_tpu.core.compile_cache import CachedStep
 from adanet_tpu.core.iteration import Iteration, IterationState
 from adanet_tpu.distributed import mesh as mesh_lib
 from adanet_tpu.distributed.placement import RoundRobinStrategy
@@ -114,13 +115,19 @@ class RoundRobinExecutor:
                 spec, out, features, labels
             )
 
+        # Per-spec programs route through the shared compile cache: a
+        # same-architecture candidate regenerated at iteration t+1 lowers
+        # to identical StableHLO on the same submesh and reuses t's
+        # executable instead of re-paying XLA compilation.
+        compile_cache = iteration.compile_cache
+
         def make_sub_step(spec, with_context):
             if not with_context:
 
                 def step(st, features, labels, key):
                     return step_body(spec, st, features, labels, key, ())
 
-                return jax.jit(step, donate_argnums=0)
+                return CachedStep(step, compile_cache, donate_argnums=0)
 
             def step_with_context(
                 st, frozen_params, prev_params, features, labels, key
@@ -130,7 +137,9 @@ class RoundRobinExecutor:
                     (frozen_params, prev_params),
                 )
 
-            return jax.jit(step_with_context, donate_argnums=0)
+            return CachedStep(
+                step_with_context, compile_cache, donate_argnums=0
+            )
 
         self._sub_steps = {
             spec.name: make_sub_step(spec, self._needs_context[spec.name])
@@ -165,7 +174,7 @@ class RoundRobinExecutor:
                 def steps(st, batch, keys):
                     return scan_subnetwork(spec, st, batch, keys, ())
 
-                return jax.jit(steps, donate_argnums=0)
+                return CachedStep(steps, compile_cache, donate_argnums=0)
 
             def steps_with_context(
                 st, frozen_params, prev_params, batch, keys
@@ -174,7 +183,9 @@ class RoundRobinExecutor:
                     spec, st, batch, keys, (frozen_params, prev_params)
                 )
 
-            return jax.jit(steps_with_context, donate_argnums=0)
+            return CachedStep(
+                steps_with_context, compile_cache, donate_argnums=0
+            )
 
         self._sub_multi_steps = {
             spec.name: make_sub_multi_step(
@@ -215,7 +226,9 @@ class RoundRobinExecutor:
                 metrics["ensemble_loss/%s" % espec.name] = loss
             return new_ens, new_cands, metrics
 
-        self._ens_step = jax.jit(ens_step, donate_argnums=(0, 1))
+        self._ens_step = CachedStep(
+            ens_step, compile_cache, donate_argnums=(0, 1)
+        )
 
         def ens_multi_step(
             ensembles, candidates, frozen, member_vars, batch
@@ -235,8 +248,8 @@ class RoundRobinExecutor:
                 lambda x: x[-1], ms
             )
 
-        self._ens_multi_step = jax.jit(
-            ens_multi_step, donate_argnums=(0, 1)
+        self._ens_multi_step = CachedStep(
+            ens_multi_step, compile_cache, donate_argnums=(0, 1)
         )
 
     # ------------------------------------------------------------------ state

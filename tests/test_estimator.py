@@ -354,6 +354,100 @@ def test_multi_head_lifecycle(tmp_path):
     assert preds["reg/predictions"].shape == (16, 1)
     assert preds["cls/class_ids"].shape == (16,)
 
+    # Multi-head serving export: the StableHLO program carries ALL heads'
+    # dict outputs with a polymorphic batch, loadable with only jax
+    # (reference exports all heads, estimator.py:1081-1118).
+    from adanet_tpu.core.export import load_serving_program, serving_signature
+
+    sample = next(input_fn())
+    export_dir = est.export_saved_model(str(tmp_path / "export"), sample)
+    serve = load_serving_program(export_dir)
+    out = serve({"x": np.random.RandomState(1).randn(5, 4).astype(np.float32)})
+    assert out["reg/predictions"].shape == (5, 1)
+    assert out["cls/probabilities"].shape == (5, 3)
+    assert out["cls/class_ids"].shape == (5,)
+    signature = serving_signature(export_dir)
+    assert set(signature["outputs"]) >= {
+        "reg/predictions",
+        "cls/probabilities",
+        "cls/class_ids",
+        "cls/logits",
+    }
+
+
+def test_multi_head_export_with_member_outputs(tmp_path):
+    """export_subnetwork_logits/last_layer flags compose with multi-head
+    dict outputs through predict AND the serialized serving program."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from adanet_tpu.core.export import load_serving_program
+    from adanet_tpu.subnetwork import Builder, Subnetwork
+
+    head = adanet_tpu.MultiHead(
+        [
+            adanet_tpu.RegressionHead(name="reg"),
+            adanet_tpu.MultiClassHead(3, name="cls"),
+        ]
+    )
+
+    class _B(Builder):
+        @property
+        def name(self):
+            return "b"
+
+        def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+            class M(nn.Module):
+                @nn.compact
+                def __call__(self, features, training=False):
+                    h = nn.relu(
+                        nn.Dense(8)(jnp.asarray(features["x"], jnp.float32))
+                    )
+                    return Subnetwork(
+                        last_layer=h,
+                        logits={
+                            k: nn.Dense(d)(h)
+                            for k, d in sorted(logits_dimension.items())
+                        },
+                        complexity=1.0,
+                    )
+
+            return M()
+
+        def build_train_optimizer(self, previous_ensemble=None):
+            return optax.sgd(0.05)
+
+    rng = np.random.RandomState(0)
+
+    def input_fn():
+        for _ in range(4):
+            x = rng.randn(16, 4).astype(np.float32)
+            yield {"x": x}, {
+                "reg": x.sum(axis=1, keepdims=True),
+                "cls": np.zeros((16,), np.int32),
+            }
+
+    est = _make_estimator(
+        tmp_path,
+        head=head,
+        subnetwork_generator=SimpleGenerator([_B()]),
+        max_iterations=1,
+        max_iteration_steps=4,
+        export_subnetwork_logits=True,
+        export_subnetwork_last_layer=True,
+    )
+    est.train(input_fn, max_steps=4)
+    preds = next(iter(est.predict(input_fn)))
+    assert set(preds["subnetwork_logits/0"]) == {"reg", "cls"}
+    assert preds["subnetwork_last_layer/0"].shape == (16, 8)
+
+    export_dir = est.export_saved_model(str(tmp_path / "export"), next(input_fn()))
+    out = load_serving_program(export_dir)(
+        {"x": np.zeros((3, 4), np.float32)}
+    )
+    assert out["subnetwork_logits/0"]["cls"].shape == (3, 3)
+    assert out["subnetwork_last_layer/0"].shape == (3, 8)
+
 
 def test_multiple_strategies_and_ensemblers_lifecycle(tmp_path):
     """Solo+Grow+All strategies x CRE+Mean ensemblers through the full

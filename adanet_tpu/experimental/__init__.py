@@ -19,7 +19,9 @@ from adanet_tpu.experimental.phases import (
     MeanEnsembler,
     ModelProvider,
     ModelSearch,
+    ParallelScheduler,
     Phase,
+    PhaseBarrier,
     RandomKStrategy,
     RepeatPhase,
     Scheduler,
@@ -27,6 +29,8 @@ from adanet_tpu.experimental.phases import (
     TrainerPhase,
     TrainerWorkUnit,
     TunerPhase,
+    WeightedEnsemble,
+    WeightedEnsembler,
     WorkUnit,
 )
 from adanet_tpu.experimental.storages import (
@@ -51,7 +55,9 @@ __all__ = [
     "ModelContainer",
     "ModelProvider",
     "ModelSearch",
+    "ParallelScheduler",
     "Phase",
+    "PhaseBarrier",
     "RandomKStrategy",
     "RepeatPhase",
     "Scheduler",
@@ -60,5 +66,7 @@ __all__ = [
     "TrainerPhase",
     "TrainerWorkUnit",
     "TunerPhase",
+    "WeightedEnsemble",
+    "WeightedEnsembler",
     "WorkUnit",
 ]

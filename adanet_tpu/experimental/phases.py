@@ -13,6 +13,7 @@ import abc
 import random
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from adanet_tpu.experimental.model import Model
@@ -31,6 +32,19 @@ class WorkUnit(abc.ABC):
     @abc.abstractmethod
     def execute(self) -> None:
         ...
+
+
+class PhaseBarrier(WorkUnit):
+    """Marks a phase boundary in the work-unit stream.
+
+    Phases read their predecessor's storage lazily when their generator is
+    first pulled, so a concurrent scheduler must finish every in-flight
+    unit before crossing a boundary. Sequential schedulers execute it as a
+    no-op.
+    """
+
+    def execute(self) -> None:
+        return None
 
 
 class TrainerWorkUnit(WorkUnit):
@@ -237,7 +251,11 @@ class MeanEnsemble(Model):
         self._submodels = list(submodels)
 
     def _ensure_initialized(self, features):
-        return  # submodels own their variables
+        # Submodels own their variables, but they must materialize them
+        # with CONCRETE features here — inside a jitted step the init
+        # would store tracers (UnexpectedTracerError on later use).
+        for submodel in self._submodels:
+            submodel._ensure_initialized(features)
 
     def __call__(self, features, training: bool = False):
         outs = [m(features, training=False) for m in self._submodels]
@@ -273,6 +291,96 @@ class MeanEnsembler:
 
     def __call__(self, submodels: List[Model]) -> MeanEnsemble:
         return MeanEnsemble(submodels, self._loss_fn, self._metrics)
+
+
+class _WeightedCombinerModule:
+    """Module-like combiner: a trainable dense over the stacked submodel
+    outputs, with frozen submodel forwards baked in.
+
+    Duck-types the Flax module surface `Model` uses (`init`/`apply`), so
+    `WeightedEnsemble` inherits fit/evaluate unchanged. Initialized at
+    1/k (exactly the mean ensemble), then the combiner weights train on
+    the ensemble loss while `stop_gradient` freezes the submodels — the
+    reference's trainable Dense over stacked outputs
+    (reference: adanet/experimental/keras/ensemble_model.py:60-87).
+    """
+
+    def __init__(self, submodels: Sequence[Model]):
+        self._submodels = tuple(submodels)
+
+    def _stacked(self, features):
+        # Model.__call__ handles plain and composite (MeanEnsemble)
+        # submodels; their variables are materialized eagerly by
+        # WeightedEnsemble._ensure_initialized, so this is trace-safe.
+        outs = [m(features, training=False) for m in self._submodels]
+        return jax.lax.stop_gradient(jnp.stack(outs, axis=-1))
+
+    def init(self, rngs, features, training: bool = False):
+        del rngs, training
+        k = len(self._submodels)
+        return {
+            "params": {
+                "mixture": jnp.full((k,), 1.0 / k, jnp.float32),
+                "bias": jnp.zeros((), jnp.float32),
+            }
+        }
+
+    def apply(self, variables, features, training: bool = False, **kwargs):
+        del training, kwargs
+        stacked = self._stacked(features)  # [batch, out, k]
+        params = variables["params"]
+        return (
+            jnp.einsum("...k,k->...", stacked, params["mixture"])
+            + params["bias"]
+        )
+
+
+class WeightedEnsemble(Model):
+    """Trainable weighted combination of frozen submodels
+    (reference: adanet/experimental/keras/ensemble_model.py:60-87)."""
+
+    def __init__(
+        self,
+        submodels: Sequence[Model],
+        loss_fn,
+        optimizer,
+        metrics=None,
+        seed: int = 0,
+    ):
+        super().__init__(
+            module=_WeightedCombinerModule(submodels),
+            loss_fn=loss_fn,
+            optimizer=optimizer,
+            metrics=metrics,
+            trainable=True,
+            seed=seed,
+        )
+        self._submodels = list(submodels)
+
+    def _ensure_initialized(self, features):
+        # Submodels must materialize their variables with CONCRETE
+        # features before any jitted combiner step traces over them.
+        for submodel in self._submodels:
+            submodel._ensure_initialized(features)
+        super()._ensure_initialized(features)
+
+    @property
+    def mixture_weights(self):
+        return self.variables["params"]["mixture"]
+
+
+class WeightedEnsembler:
+    """Combines submodels into a trainable `WeightedEnsemble`."""
+
+    def __init__(self, loss_fn, optimizer, metrics=None):
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._metrics = metrics
+
+    def __call__(self, submodels: List[Model]) -> WeightedEnsemble:
+        return WeightedEnsemble(
+            submodels, self._loss_fn, self._optimizer, self._metrics
+        )
 
 
 class AutoEnsemblePhase(DatasetProvider, ModelProvider):
@@ -345,6 +453,7 @@ class RepeatPhase(DatasetProvider, ModelProvider):
                 phase = factory()
                 for work_unit in phase.work_units(prev):
                     yield work_unit
+                yield PhaseBarrier()  # see SequentialController.work_units
                 prev = phase
         self._final_phase = prev
 
@@ -390,6 +499,9 @@ class SequentialController(Controller):
         for phase in self._phases:
             for work_unit in phase.work_units(previous):
                 yield work_unit
+            # Later phases read this phase's storage when their generator
+            # is pulled; the barrier keeps concurrent schedulers correct.
+            yield PhaseBarrier()
             previous = phase
         self._final_phase = previous
 
@@ -412,6 +524,51 @@ class InProcessScheduler(Scheduler):
     def schedule(self, work_units: Iterator[WorkUnit]) -> None:
         for work_unit in work_units:
             work_unit.execute()
+
+
+class ParallelScheduler(Scheduler):
+    """Runs a phase's work units concurrently, one device group each.
+
+    The distributed scheduler the reference left as unimplemented intent
+    (reference: adanet/experimental/schedulers/scheduler.py — only the
+    in-process one exists; SURVEY §2.7). Each worker thread pins its
+    units' computations to one device of a disjoint group via
+    `jax.default_device`, so independent model fits overlap across the
+    mesh exactly like RoundRobin candidate training in the core engine.
+    `PhaseBarrier`s drain in-flight units, preserving the phase-chaining
+    contract (later phases read earlier phases' storages).
+    """
+
+    def __init__(self, num_workers: Optional[int] = None, devices=None):
+        self._devices = list(devices) if devices is not None else None
+        self._num_workers = num_workers
+
+    def schedule(self, work_units: Iterator[WorkUnit]) -> None:
+        import concurrent.futures
+
+        devices = (
+            self._devices if self._devices is not None else jax.devices()
+        )
+        num_workers = self._num_workers or len(devices)
+
+        def run_on(device, work_unit):
+            with jax.default_device(device):
+                work_unit.execute()
+
+        with concurrent.futures.ThreadPoolExecutor(num_workers) as pool:
+            pending = []
+            index = 0
+            for work_unit in work_units:
+                if isinstance(work_unit, PhaseBarrier):
+                    for future in pending:
+                        future.result()  # surface worker exceptions
+                    pending = []
+                    continue
+                device = devices[index % len(devices)]
+                index += 1
+                pending.append(pool.submit(run_on, device, work_unit))
+            for future in pending:
+                future.result()
 
 
 class ModelSearch:

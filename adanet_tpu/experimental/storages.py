@@ -10,6 +10,7 @@ from __future__ import annotations
 import abc
 import heapq
 import itertools
+import threading
 from typing import Any, List, Sequence
 
 
@@ -46,21 +47,30 @@ class Storage(abc.ABC):
 
 
 class InMemoryStorage(Storage):
-    """Heap-ordered in-memory store (reference: in_memory_storage.py:26-59)."""
+    """Heap-ordered in-memory store (reference: in_memory_storage.py:26-59).
+
+    Thread-safe: `ParallelScheduler` work units save concurrently.
+    """
 
     def __init__(self):
         self._containers: List[ModelContainer] = []
+        self._lock = threading.Lock()
 
     def save_model(self, model_container: ModelContainer):
-        heapq.heappush(self._containers, model_container)
+        with self._lock:
+            heapq.heappush(self._containers, model_container)
 
     def get_models(self) -> List[Any]:
-        return [c.model for c in self._containers]
+        with self._lock:
+            return [c.model for c in self._containers]
 
     def get_best_models(self, num_models: int = 1) -> List[Any]:
-        return [
-            c.model for c in heapq.nsmallest(num_models, self._containers)
-        ]
+        with self._lock:
+            return [
+                c.model
+                for c in heapq.nsmallest(num_models, self._containers)
+            ]
 
     def get_model_metrics(self) -> List[List[float]]:
-        return [c.metrics for c in self._containers]
+        with self._lock:
+            return [c.metrics for c in self._containers]

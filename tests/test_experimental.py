@@ -12,15 +12,19 @@ from adanet_tpu.experimental import (
     GrowStrategy,
     InMemoryStorage,
     InputPhase,
+    MeanEnsemble,
     MeanEnsembler,
     Model,
     ModelContainer,
     ModelSearch,
+    ParallelScheduler,
     RandomKStrategy,
     RepeatPhase,
     SequentialController,
     TrainerPhase,
     TunerPhase,
+    WeightedEnsemble,
+    WeightedEnsembler,
 )
 
 
@@ -132,3 +136,114 @@ def test_random_k_strategy():
     assert len(groups) == 1
     assert len(groups[0]) == 3
     assert set(groups[0]) <= {"a", "b"}
+
+
+def test_weighted_ensemble_initializes_as_mean_then_improves():
+    """WeightedEnsemble (reference: keras/ensemble_model.py:60-87) starts
+    exactly at the mean ensemble (1/k weights) and its trained combiner
+    must not underperform the mean; submodels stay frozen."""
+    import jax
+
+    submodels = [_model(8, seed=0), _model(16, seed=1)]
+    for submodel in submodels:
+        submodel.fit(_dataset(0), epochs=5)
+        submodel.trainable = False
+
+    mean = MeanEnsemble(submodels, _mse)
+    weighted = WeightedEnsemble(
+        submodels, _mse, optimizer=optax.sgd(0.05)
+    )
+    # Before training: identical to the mean ensemble.
+    np.testing.assert_allclose(
+        weighted.evaluate(_dataset(1)())[0],
+        mean.evaluate(_dataset(1)())[0],
+        rtol=1e-5,
+    )
+
+    before_train_loss = weighted.evaluate(_dataset(0)())[0]
+    frozen_before = jax.device_get(submodels[0].variables["params"])
+    weighted.fit(_dataset(0), epochs=10)
+    # Combiner trained, submodels untouched.
+    assert not np.allclose(
+        np.asarray(weighted.mixture_weights), [0.5, 0.5]
+    )
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal,
+        frozen_before,
+        jax.device_get(submodels[0].variables["params"]),
+    )
+    # Training the combiner improves (or at worst matches, within SGD
+    # noise) its own starting loss — which IS the mean ensemble's.
+    assert weighted.evaluate(_dataset(0)())[0] <= before_train_loss * 1.02
+
+
+def test_weighted_ensemble_over_fresh_composite_submodel():
+    """A WeightedEnsemble wrapping a never-fit MeanEnsemble must
+    materialize the inner model's variables eagerly — not inside the
+    jitted step (which would leak tracers into inner.variables)."""
+    inner = _model(8, seed=0)
+    weighted = WeightedEnsemble(
+        [MeanEnsemble([inner], _mse)], _mse, optimizer=optax.sgd(0.05)
+    )
+    first = weighted.evaluate(_dataset(1)())
+    second = weighted.evaluate(_dataset(1)())  # raised before the fix
+    np.testing.assert_allclose(first[0], second[0], rtol=1e-6)
+    weighted.fit(_dataset(0), epochs=1)
+
+
+def test_autoensemble_phase_with_weighted_ensembler():
+    phases = [
+        InputPhase(_dataset(0), _dataset(1)),
+        TrainerPhase([_model(8, seed=0), _model(16, seed=1)], epochs=5),
+        AutoEnsemblePhase(
+            ensemblers=[
+                MeanEnsembler(_mse),
+                WeightedEnsembler(_mse, optimizer=optax.sgd(0.05)),
+            ],
+            ensemble_strategies=[AllStrategy()],
+            num_candidates=2,
+        ),
+    ]
+    search = ModelSearch(SequentialController(phases))
+    search.run()
+    best = list(search.get_best_models(2))
+    assert len(best) == 2
+    assert any(isinstance(m, WeightedEnsemble) for m in best)
+
+
+def test_parallel_scheduler_matches_sequential():
+    """The submesh-parallel scheduler (the reference's unimplemented
+    intent, SURVEY §2.7) must produce the same best models as the
+    sequential one: barriers preserve phase chaining while units within
+    a phase run concurrently on distinct devices."""
+
+    def build_phases():
+        return [
+            InputPhase(_dataset(0), _dataset(1)),
+            TrainerPhase(
+                [_model(4, seed=0), _model(8, seed=1), _model(16, seed=2)],
+                epochs=3,
+            ),
+            AutoEnsemblePhase(
+                ensemblers=[MeanEnsembler(_mse)],
+                ensemble_strategies=[GrowStrategy(), AllStrategy()],
+                num_candidates=3,
+            ),
+        ]
+
+    sequential = ModelSearch(SequentialController(build_phases()))
+    sequential.run()
+    seq_best = list(sequential.get_best_models(1))[0]
+
+    parallel = ModelSearch(
+        SequentialController(build_phases()),
+        scheduler=ParallelScheduler(),
+    )
+    parallel.run()
+    par_best = list(parallel.get_best_models(1))[0]
+
+    np.testing.assert_allclose(
+        seq_best.evaluate(_dataset(1)())[0],
+        par_best.evaluate(_dataset(1)())[0],
+        rtol=1e-5,
+    )

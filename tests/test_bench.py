@@ -1,0 +1,40 @@
+"""Driver-contract test for bench.py: one JSON line with honest fields."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_prints_one_json_line():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    # Driver contract fields.
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in result, result
+    # Honest-accounting fields (round-2 verdict).
+    assert result["flops_model"].startswith("XLA")
+    assert result["vs_baseline_note"]
+    for config in ("nasnet", "cnn"):
+        assert result[config]["examples_per_sec_per_chip"] > 0
+        assert result[config]["flops_per_example"] is None or (
+            result[config]["flops_per_example"] > 0
+        )
+    # On CPU there is no axon tunnel: no timing caveat, no MFU peak.
+    assert "timing_caveat" not in result

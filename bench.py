@@ -66,7 +66,10 @@ def _axon_tunnel() -> bool:
     return "axon" in os.environ.get("JAX_PLATFORMS", "").lower()
 
 
-def _measure_iteration(builders, batch_size, image_size=32):
+IMAGE_SIZE = 32
+
+
+def _measure_iteration(builders, batch_size):
     """Times `MEASURE_STEPS` fused train steps; returns throughput + MFU."""
     from adanet_tpu.core.heads import MultiClassHead
     from adanet_tpu.core.iteration import IterationBuilder
@@ -99,7 +102,7 @@ def _measure_iteration(builders, batch_size, image_size=32):
     batch = (
         {
             "image": rng.randn(
-                global_batch, image_size, image_size, 3
+                global_batch, IMAGE_SIZE, IMAGE_SIZE, 3
             ).astype(np.float32)
         },
         rng.randint(0, 10, size=(global_batch,)),

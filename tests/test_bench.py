@@ -13,6 +13,12 @@ def test_bench_prints_one_json_line():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # Reuse the suite's persistent XLA cache: the NASNet-A compile is the
+    # dominant cost of this test on CPU.
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py")],
         cwd=repo,

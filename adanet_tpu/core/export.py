@@ -30,20 +30,25 @@ SERVING_FILE = "serving.stablehlo"
 SIGNATURE_FILE = "serving_signature.json"
 
 
+DEFAULT_PLATFORMS = ("cpu", "tpu")
+
+
 def export_serving_program(
     export_dir: str,
     predict_fn: Callable,
     sample_features: Any,
     polymorphic_batch: bool = True,
+    platforms=DEFAULT_PLATFORMS,
 ) -> str:
     """Serializes `predict_fn(features) -> predictions` with params baked in.
 
     With `polymorphic_batch` (default) the leading dimension is exported as
     a symbolic size so the served program accepts any batch size, like a
-    SavedModel; models whose lowering requires a concrete batch fall back
-    to the sample batch's size (recorded in the signature). The artifact
-    targets the current backend platform (`jax.export` records it; serve on
-    the same platform family).
+    SavedModel. The artifact is MULTI-PLATFORM by default (`platforms`):
+    lowered once per target so a model exported on a TPU trainer serves on
+    CPU fleets and vice versa — the SavedModel portability the reference
+    gets from TF. Programs whose lowering is platform-specialized fall
+    back to the current backend only (recorded in the signature).
     """
 
     def arg_shapes(batch_dim):
@@ -54,23 +59,52 @@ def export_serving_program(
             sample_features,
         )
 
+    # Always include the exporting machine's own backend so the artifact
+    # can at least be served where it was produced (e.g. a cuda host).
+    target_platforms = None
+    if platforms:
+        target_platforms = list(platforms)
+        backend = jax.default_backend()
+        if backend not in target_platforms:
+            target_platforms.append(backend)
+
+    def try_export(shapes, multi_platform):
+        kwargs = (
+            {"platforms": target_platforms} if multi_platform else {}
+        )
+        return jax.export.export(jax.jit(predict_fn), **kwargs)(shapes)
+
+    concrete = np.asarray(
+        jax.tree_util.tree_leaves(sample_features)[0]
+    ).shape[0]
     exported = None
+    last_error = None
+    attempts = []
     if polymorphic_batch:
+        (batch_sym,) = jax.export.symbolic_shape("batch")
+        attempts.append((batch_sym, bool(target_platforms)))
+        if target_platforms:
+            attempts.append((batch_sym, False))
+    attempts.append((concrete, bool(target_platforms)))
+    if target_platforms:
+        attempts.append((concrete, False))
+    for batch_dim, multi_platform in attempts:
         try:
-            (batch_sym,) = jax.export.symbolic_shape("batch")
-            exported = jax.export.export(jax.jit(predict_fn))(
-                arg_shapes(batch_sym)
-            )
-        except Exception as e:  # shape-specialized models fall back
+            exported = try_export(arg_shapes(batch_dim), multi_platform)
+            break
+        except Exception as e:  # specialized models fall back
+            last_error = e
             _LOG.info(
-                "Polymorphic-batch export failed (%s); pinning the sample "
-                "batch size.",
+                "Export attempt (batch=%s, multi_platform=%s) failed: %s",
+                batch_dim,
+                multi_platform,
                 e,
             )
     if exported is None:
-        exported = jax.export.export(jax.jit(predict_fn))(
-            arg_shapes(np.asarray(jax.tree_util.tree_leaves(sample_features)[0]).shape[0])
-        )
+        raise ValueError(
+            "Could not export the serving program for any configuration; "
+            "last error: %s" % last_error
+        ) from last_error
 
     os.makedirs(export_dir, exist_ok=True)
     path = os.path.join(export_dir, SERVING_FILE)

@@ -449,6 +449,24 @@ def test_multi_head_export_with_member_outputs(tmp_path):
     assert out["subnetwork_last_layer/0"].shape == (3, 8)
 
 
+def test_export_is_multi_platform(tmp_path):
+    """The serving artifact carries cpu AND tpu lowerings (SavedModel-like
+    portability): exported under one backend, it loads and declares both
+    platforms."""
+    from adanet_tpu.core.export import load_serving_program, serving_signature
+
+    est = _make_estimator(tmp_path, max_iterations=1)
+    est.train(linear_dataset(), max_steps=8)
+    sample = next(linear_dataset()())
+    export_dir = est.export_saved_model(str(tmp_path / "export"), sample)
+    signature = serving_signature(export_dir)
+    assert set(p.lower() for p in signature["platforms"]) >= {"cpu", "tpu"}
+    out = load_serving_program(export_dir)(
+        {"x": np.zeros((3, 2), np.float32)}
+    )
+    assert out["predictions"].shape == (3, 1)
+
+
 def test_multiple_strategies_and_ensemblers_lifecycle(tmp_path):
     """Solo+Grow+All strategies x CRE+Mean ensemblers through the full
     search (the reference's candidates-per-iteration cross product,

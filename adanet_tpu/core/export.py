@@ -64,7 +64,10 @@ def export_serving_program(
     target_platforms = None
     if platforms:
         target_platforms = list(platforms)
-        backend = jax.default_backend()
+        # default_export_platform() canonicalizes the backend name for
+        # jax.export (e.g. 'gpu' -> 'cuda'); raw jax.default_backend()
+        # would be rejected on GPU hosts.
+        backend = jax.export.default_export_platform()
         if backend not in target_platforms:
             target_platforms.append(backend)
 

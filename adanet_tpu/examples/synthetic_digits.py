@@ -55,17 +55,30 @@ def make_dataset(
     return images[..., None], labels.astype(np.int32)
 
 
-def input_fn(
-    images: np.ndarray, labels: np.ndarray, batch_size: int = 128
+def _batched_input_fn(
+    key: str, features: np.ndarray, labels: np.ndarray, batch_size: int
 ) -> Callable[[], Iterator]:
-    """Zero-arg input_fn yielding flat-feature batches."""
-    flat = images.reshape(images.shape[0], -1)
-
     def fn():
-        for start in range(0, len(flat), batch_size):
+        for start in range(0, len(features), batch_size):
             yield (
-                {"x": flat[start : start + batch_size]},
+                {key: features[start : start + batch_size]},
                 labels[start : start + batch_size],
             )
 
     return fn
+
+
+def input_fn(
+    images: np.ndarray, labels: np.ndarray, batch_size: int = 128
+) -> Callable[[], Iterator]:
+    """Zero-arg input_fn yielding flat-feature batches (DNN families)."""
+    return _batched_input_fn(
+        "x", images.reshape(images.shape[0], -1), labels, batch_size
+    )
+
+
+def image_input_fn(
+    images: np.ndarray, labels: np.ndarray, batch_size: int = 128
+) -> Callable[[], Iterator]:
+    """Zero-arg input_fn yielding image batches (CNN/NASNet families)."""
+    return _batched_input_fn("image", images, labels, batch_size)

@@ -65,6 +65,12 @@ def main():
     from adanet_tpu.subnetwork import SimpleGenerator
 
     devices = jax.devices()
+    if args.seq_len % len(devices) != 0:
+        raise SystemExit(
+            "seq_len=%d must be divisible by the %d devices forming the "
+            "sp axis; pick --seq_len or --devices accordingly."
+            % (args.seq_len, len(devices))
+        )
     sp_mesh = Mesh(np.asarray(devices), axis_names=("sp",))
     print(
         "ring attention over %d devices (%s); seq_len=%d -> %d per device"
@@ -86,14 +92,15 @@ def main():
                 tokens = rng.randint(
                     0, vocab - 1, size=(args.batch_size, args.seq_len)
                 )
-                # The marker lands in the first or second half — far from
-                # the end either way, so the classifier must carry
-                # information across the whole (sharded) sequence.
+                # The marker lands in the first or third quarter — never
+                # near the sequence end — so a model reading only the
+                # tail shard cannot shortcut: the label must travel
+                # across the ring.
                 labels = rng.randint(0, 2, size=(args.batch_size,))
-                half = args.seq_len // 2
+                quarter = args.seq_len // 4
                 for row, label in enumerate(labels):
-                    lo = 0 if label == 0 else half
-                    tokens[row, rng.randint(lo, lo + half)] = marker
+                    lo = 0 if label == 0 else 2 * quarter
+                    tokens[row, rng.randint(lo, lo + quarter)] = marker
                 yield {"tokens": tokens}, labels.astype(np.int32)
 
         return fn

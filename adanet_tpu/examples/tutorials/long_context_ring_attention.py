@@ -9,10 +9,10 @@ the devices via `ppermute` over ICI while queries stay put — inside the
 fused jitted train step (`adanet_tpu/parallel/ring_attention.py`).
 
 The task is synthetic long-range retrieval: each sequence embeds a
-marker token whose POSITION (early/late half) decides the label, with the
-signal placed far from the sequence end so short-range models cannot
-shortcut. An AdaNet search grows an ensemble of 1-layer and 2-layer
-transformer candidates.
+marker token whose POSITION decides the label — first quarter = 0, third
+quarter = 1 — so the signal never sits near the sequence end and a model
+reading only the tail shard cannot shortcut. An AdaNet search grows an
+ensemble of 1-layer and 2-layer transformer candidates.
 
 Run (8 virtual devices):
   python -m adanet_tpu.examples.tutorials.long_context_ring_attention

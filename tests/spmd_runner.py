@@ -51,6 +51,8 @@ def main():
     import optax
 
     import adanet_tpu
+    from adanet_tpu.core.evaluator import Evaluator
+    from adanet_tpu.core.report_materializer import ReportMaterializer
     from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
     from adanet_tpu.subnetwork import SimpleGenerator
 
@@ -85,21 +87,36 @@ def main():
                 )
             return frozen
 
+    # Evaluator + report materializer make the bookkeeping phase a
+    # COLLECTIVE program (global-batch eval_step / report metrics via the
+    # estimator's batch placer) that every process must run in lockstep —
+    # the highest-deadlock-risk multi-host path, exercised for real here.
     est = ProbeEstimator(
         head=adanet_tpu.RegressionHead(),
         subnetwork_generator=SimpleGenerator(
-            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+            [
+                DNNBuilder("a", 1, with_report=True),
+                DNNBuilder("b", 2, with_report=True),
+            ]
         ),
         max_iteration_steps=6,
         ensemblers=[
             ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
         ],
+        evaluator=Evaluator(input_fn=local_input_fn),
+        report_materializer=ReportMaterializer(
+            input_fn=local_input_fn, steps=2
+        ),
         max_iterations=2,
         model_dir=model_dir,
         log_every_steps=0,
     )
     est.train(local_input_fn, max_steps=100)
     assert est.latest_iteration_number() == 2
+    if process_id == 0:
+        # The chief wrote the report store fed by the collective metrics.
+        reports = est._report_accessor.read_iteration_reports()
+        assert len(reports) == 2 and reports[0], reports
 
     np.savez(
         os.path.join(model_dir, "probe_%d.npz" % process_id), **probes

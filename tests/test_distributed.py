@@ -252,13 +252,23 @@ def test_multi_host_spmd_data_path(tmp_path):
     def oracle_input_fn():
         return iter(full_batches())
 
+    from adanet_tpu.core.evaluator import Evaluator
+    from adanet_tpu.core.report_materializer import ReportMaterializer
+
     est = adanet_tpu.Estimator(
         head=adanet_tpu.RegressionHead(),
         subnetwork_generator=SimpleGenerator(
-            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+            [
+                DNNBuilder("a", 1, with_report=True),
+                DNNBuilder("b", 2, with_report=True),
+            ]
         ),
         max_iteration_steps=6,
         ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        evaluator=Evaluator(input_fn=oracle_input_fn),
+        report_materializer=ReportMaterializer(
+            input_fn=oracle_input_fn, steps=2
+        ),
         max_iterations=2,
         model_dir=str(tmp_path / "oracle_model"),
         log_every_steps=0,

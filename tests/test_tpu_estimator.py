@@ -95,6 +95,37 @@ def test_checkpoint_interval_crossing_with_loops(tmp_path):
     assert files  # a mid-iteration checkpoint was written
 
 
+def test_padded_predict_batching(tmp_path):
+    """Fixed-size inference batching (the reference's inference-on-TPU
+    batch config): ragged batches pad to one compiled shape and outputs
+    slice back to true row counts, matching unpadded predictions."""
+    est = _make(tmp_path, max_iterations=1, predict_batch_size=16)
+    est.train(linear_dataset(), max_steps=8)
+
+    def ragged_input_fn():
+        rng = np.random.RandomState(1)
+        for size in (16, 9, 3):
+            x = rng.randn(size, 2).astype(np.float32)
+            yield {"x": x}, x.sum(axis=1, keepdims=True)
+
+    padded = list(est.predict(ragged_input_fn))
+    assert [p["predictions"].shape[0] for p in padded] == [16, 9, 3]
+    plain = list(est.predict(ragged_input_fn, predict_batch_size=0))
+    for a, b in zip(padded, plain):
+        np.testing.assert_allclose(
+            a["predictions"], b["predictions"], rtol=1e-5
+        )
+
+    # Oversized batches are rejected with an actionable error.
+    import pytest
+
+    def oversized():
+        yield {"x": np.zeros((17, 2), np.float32)}, None
+
+    with pytest.raises(ValueError, match="exceeds"):
+        list(est.predict(oversized, predict_batch_size=16))
+
+
 def test_metric_fn(tmp_path):
     def metric_fn(logits, labels):
         return {

@@ -26,7 +26,9 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import signal
 import tempfile
+import threading
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
@@ -137,6 +139,7 @@ class Estimator:
         iterations_per_loop: int = 1,
         profile_dir: Optional[str] = None,
         profile_steps: int = 5,
+        checkpoint_on_sigterm: bool = True,
         debug: bool = False,
         placement_strategy=None,
         export_subnetwork_logits: bool = False,
@@ -179,6 +182,13 @@ class Estimator:
         self._iterations_per_loop = int(iterations_per_loop)
         self._profile_dir = profile_dir
         self._profile_steps = int(profile_steps)
+        # Preemption safety (SURVEY §5.3): on SIGTERM, finish the current
+        # step, persist the mid-iteration state, and exit cleanly so a
+        # fresh process resumes exactly. In multi-host SPMD the signal
+        # must reach every process (the usual preemption semantics);
+        # a single-process stop would leave peers blocked in collectives.
+        self._checkpoint_on_sigterm = bool(checkpoint_on_sigterm)
+        self._stop_requested = False
         # debug=True validates every batch for non-finite values before it
         # reaches the device, the analogue of the reference's debug-mode
         # feature/label NaN asserts (reference: estimator.py:386-439).
@@ -286,11 +296,54 @@ class Estimator:
         # restart, i.e. the first pass).
         cached_previous: Optional[FrozenEnsemble] = None
 
+        self._stop_requested = False
+        previous_handler = None
+        handler_installed = False
+        if (
+            self._checkpoint_on_sigterm
+            and threading.current_thread() is threading.main_thread()
+        ):
+
+            def handler(signum, frame):
+                if self._stop_requested:
+                    # Second signal: defer to the original disposition so
+                    # a stuck run can still be killed. (None = a non-
+                    # Python handler we cannot restore; use the default.)
+                    signal.signal(
+                        signal.SIGTERM,
+                        previous_handler
+                        if previous_handler is not None
+                        else signal.SIG_DFL,
+                    )
+                    if callable(previous_handler):
+                        previous_handler(signum, frame)
+                    else:
+                        raise SystemExit(128 + signum)
+                    return
+                _LOG.warning(
+                    "SIGTERM received: checkpointing at the next step "
+                    "boundary, then stopping."
+                )
+                self._stop_requested = True
+
+            try:
+                previous_handler = signal.signal(signal.SIGTERM, handler)
+                handler_installed = True
+            except ValueError:  # non-main interpreter contexts
+                handler_installed = False
+
         try:
             self._train_loop(
                 input_fn, max_steps, info, data_iter, cached_previous
             )
         finally:
+            if handler_installed:
+                signal.signal(
+                    signal.SIGTERM,
+                    previous_handler
+                    if previous_handler is not None
+                    else signal.SIG_DFL,
+                )
             # Post-training evaluate()/predict() are per-process local
             # programs (the frozen winner restores from disk as host
             # arrays); during the search, global metrics come from the
@@ -300,11 +353,31 @@ class Estimator:
             self._spmd_mesh = None
         return self
 
+    def _should_stop(self) -> bool:
+        """The stop decision, agreed across processes under SPMD.
+
+        A preemption signal may land between loop-boundary checks on
+        different processes; deciding from the local flag alone could
+        leave one process entering a collective step the others skip
+        (deadlock). Under SPMD every process allgathers its flag at the
+        SAME boundaries, so all stop iff ANY was signaled.
+        """
+        if self._spmd_mesh is None:
+            return self._stop_requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._stop_requested, np.int32)
+        )
+        return bool(np.max(flags))
+
     def _train_loop(
         self, input_fn, max_steps, info, data_iter, cached_previous
     ):
         while True:
             t = info.iteration_number
+            if self._should_stop():
+                break
             if self._max_iterations is not None and t >= self._max_iterations:
                 _LOG.info("Reached max_iterations=%d.", self._max_iterations)
                 break
@@ -358,8 +431,10 @@ class Estimator:
             )
             profiling = False
             profiled = False
-            while steps_done < self._max_iteration_steps and (
-                max_steps is None or info.global_step < max_steps
+            while (
+                steps_done < self._max_iteration_steps
+                and not self._should_stop()
+                and (max_steps is None or info.global_step < max_steps)
             ):
                 if (
                     self._profile_dir
@@ -481,9 +556,19 @@ class Estimator:
                 state = executor.gather(state)
 
             if steps_done < self._max_iteration_steps:
-                # Interrupted by max_steps: persist mid-iteration and stop.
+                # Interrupted (max_steps budget or SIGTERM): persist the
+                # mid-iteration state and stop; a fresh process resumes
+                # from exactly this step.
                 if coordination.is_chief():
                     self._save_iteration_state(info, t, state)
+                if self._stop_requested:
+                    _LOG.warning(
+                        "Stopped by SIGTERM at global step %d "
+                        "(iteration %d, step %d); state checkpointed.",
+                        info.global_step,
+                        t,
+                        steps_done,
+                    )
                 break
 
             if self._spmd_mesh is not None:

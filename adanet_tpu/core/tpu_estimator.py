@@ -56,8 +56,10 @@ class TPUEstimator(Estimator):
         super().__init__(
             *args, iterations_per_loop=iterations_per_loop, **kwargs
         )
-        if predict_batch_size is not None and predict_batch_size < 1:
-            raise ValueError("predict_batch_size must be >= 1.")
+        if predict_batch_size is not None and predict_batch_size < 0:
+            raise ValueError(
+                "predict_batch_size must be >= 1 (or 0 to disable)."
+            )
         self._predict_batch_size = predict_batch_size
 
     def predict(
@@ -85,7 +87,9 @@ class TPUEstimator(Estimator):
             yield from super().predict(input_fn)
             return
 
-        sizes = []
+        import collections
+
+        sizes = collections.deque()
 
         def padded_input_fn():
             for batch in input_fn():
@@ -103,6 +107,6 @@ class TPUEstimator(Estimator):
             arr = np.asarray(x)
             return arr[:n] if arr.ndim >= 1 else arr
 
-        for index, preds in enumerate(super().predict(padded_input_fn)):
-            n = sizes[index]
+        for preds in super().predict(padded_input_fn):
+            n = sizes.popleft()  # bounded memory on unbounded streams
             yield jax.tree_util.tree_map(lambda x: unpad(x, n), preds)

@@ -1,0 +1,57 @@
+"""Preemption runner: trains until SIGTERM, then must exit cleanly.
+
+Spawned by `test_estimator.py::test_sigterm_checkpoints_and_resumes`.
+Prints READY once training started so the parent knows when to signal.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import optax
+
+import adanet_tpu
+from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+from adanet_tpu.subnetwork import SimpleGenerator
+
+from helpers import DNNBuilder
+
+
+def main():
+    model_dir = sys.argv[1]
+
+    pulls = 0
+
+    def input_fn():
+        nonlocal pulls
+        rng = np.random.RandomState(0)
+        while True:
+            pulls += 1
+            # One batch is consumed per train step (plus the sample pull),
+            # so by the 20th pull compilation is long done and real steps
+            # are flowing — safe for the parent to preempt.
+            if pulls == 20:
+                print("READY", flush=True)
+            x = rng.randn(16, 2).astype(np.float32)
+            yield {"x": x}, x.sum(axis=1, keepdims=True)
+
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator([DNNBuilder("dnn", 1)]),
+        max_iteration_steps=10**6,  # far beyond the signal
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        model_dir=model_dir,
+        log_every_steps=0,
+        save_checkpoint_steps=None,  # only the SIGTERM path may save
+    )
+    est.train(input_fn)  # runs until the signal stops it
+    print("STOPPED AT", est.latest_global_step(), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,11 @@
 """Model zoo: TPU-first Flax implementations of workload architectures."""
 
+from adanet_tpu.models.efficientnet import (
+    EfficientNet,
+    EfficientNetBuilder,
+)
 from adanet_tpu.models.nasnet import NasNetA, NasNetConfig, calc_reduction_layers
+from adanet_tpu.models.resnet import ResNet, ResNetBuilder
 from adanet_tpu.models.transformer import (
     TransformerBuilder,
     TransformerConfig,
@@ -8,8 +13,12 @@ from adanet_tpu.models.transformer import (
 )
 
 __all__ = [
+    "EfficientNet",
+    "EfficientNetBuilder",
     "NasNetA",
     "NasNetConfig",
+    "ResNet",
+    "ResNetBuilder",
     "TransformerBuilder",
     "TransformerConfig",
     "TransformerEncoder",

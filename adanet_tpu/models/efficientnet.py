@@ -1,0 +1,231 @@
+"""EfficientNet in Flax: the second ImageNet-class candidate family.
+
+BASELINE.json config 5 pairs ResNet-50 with EfficientNet-B0 in an
+AutoEnsemble. From-scratch TPU-idiomatic implementation: MBConv blocks
+(expand -> depthwise -> squeeze-excite -> project) in bfloat16 with
+float32 batch-norm/logits, compound width/depth scaling for the B0-B3
+variants, stochastic depth on the residual branches.
+
+Architecture follows Tan & Le (arXiv:1905.11946); the reference framework
+ships no EfficientNet — the config comes from its BASELINE north star.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from adanet_tpu.models.resnet import batch_norm
+from adanet_tpu.subnetwork import Builder, Subnetwork
+
+# (expand_ratio, channels, repeats, stride, kernel) per stage — B0 table.
+_B0_STAGES: Tuple[Tuple[int, int, int, int, int], ...] = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+# (width_mult, depth_mult) compound-scaling coefficients.
+EFFICIENTNET_SCALING = {
+    "b0": (1.0, 1.0),
+    "b1": (1.0, 1.1),
+    "b2": (1.1, 1.2),
+    "b3": (1.2, 1.4),
+}
+
+
+def _round_channels(channels: float, divisor: int = 8) -> int:
+    rounded = max(divisor, int(channels + divisor / 2) // divisor * divisor)
+    if rounded < 0.9 * channels:  # never round down by more than 10%
+        rounded += divisor
+    return rounded
+
+
+def _round_repeats(repeats: int, depth_mult: float) -> int:
+    return int(math.ceil(depth_mult * repeats))
+
+
+class _SqueezeExcite(nn.Module):
+    reduced: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.reduced, (1, 1), dtype=self.compute_dtype)(pooled)
+        s = nn.silu(s)
+        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.compute_dtype)(s)
+        return x * jax.nn.sigmoid(s)
+
+
+class _MBConv(nn.Module):
+    expand_ratio: int
+    filters: int
+    stride: int
+    kernel: int
+    drop_rate: float
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        dtype = self.compute_dtype
+        norm = lambda name: batch_norm(training, name)
+        inputs = x
+        in_filters = x.shape[-1]
+        expanded = in_filters * self.expand_ratio
+        if self.expand_ratio != 1:
+            x = nn.Conv(
+                expanded, (1, 1), use_bias=False, dtype=dtype, name="expand"
+            )(x)
+            x = nn.silu(norm("expand_bn")(x))
+        x = nn.Conv(
+            expanded,
+            (self.kernel, self.kernel),
+            strides=self.stride,
+            feature_group_count=expanded,
+            use_bias=False,
+            dtype=dtype,
+            name="depthwise",
+        )(x)
+        x = nn.silu(norm("dw_bn")(x))
+        x = _SqueezeExcite(
+            reduced=max(1, in_filters // 4),
+            compute_dtype=dtype,
+            name="se",
+        )(x)
+        x = nn.Conv(
+            self.filters, (1, 1), use_bias=False, dtype=dtype, name="project"
+        )(x)
+        x = norm("project_bn")(x)
+        if self.stride == 1 and in_filters == self.filters:
+            if training and self.drop_rate > 0.0:
+                # Stochastic depth: drop the whole residual branch.
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(
+                    rng, keep, (x.shape[0], 1, 1, 1)
+                )
+                x = jnp.asarray(mask, x.dtype) * x / keep
+            x = x + jnp.asarray(inputs, x.dtype)
+        return x
+
+
+class EfficientNet(nn.Module):
+    """EfficientNet backbone emitting an AdaNet `Subnetwork`."""
+
+    logits_dimension: int
+    variant: str = "b0"
+    compute_dtype: Any = jnp.bfloat16
+    drop_path_rate: float = 0.2
+    small_inputs: bool = False  # stride-1 stem for CIFAR-size images
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        if self.variant not in EFFICIENTNET_SCALING:
+            raise ValueError(
+                "variant must be one of %s" % sorted(EFFICIENTNET_SCALING)
+            )
+        width_mult, depth_mult = EFFICIENTNET_SCALING[self.variant]
+        x = features["image"] if isinstance(features, dict) else features
+        x = jnp.asarray(x, self.compute_dtype)
+
+        stem = _round_channels(32 * width_mult)
+        x = nn.Conv(
+            stem,
+            (3, 3),
+            strides=1 if self.small_inputs else 2,
+            use_bias=False,
+            dtype=self.compute_dtype,
+            name="stem",
+        )(x)
+        x = nn.silu(batch_norm(training, "stem_bn")(x))
+
+        total_blocks = sum(
+            _round_repeats(r, depth_mult) for _, _, r, _, _ in _B0_STAGES
+        )
+        block_index = 0
+        for stage, (expand, channels, repeats, stride, kernel) in enumerate(
+            _B0_STAGES
+        ):
+            out = _round_channels(channels * width_mult)
+            for block in range(_round_repeats(repeats, depth_mult)):
+                x = _MBConv(
+                    expand_ratio=expand,
+                    filters=out,
+                    stride=stride if block == 0 else 1,
+                    kernel=kernel,
+                    drop_rate=self.drop_path_rate
+                    * block_index
+                    / max(total_blocks, 1),
+                    compute_dtype=self.compute_dtype,
+                    name="stage%d_block%d" % (stage, block),
+                )(x, training)
+                block_index += 1
+
+        head = _round_channels(1280 * width_mult)
+        x = nn.Conv(
+            head, (1, 1), use_bias=False, dtype=self.compute_dtype, name="head"
+        )(x)
+        x = nn.silu(batch_norm(training, "head_bn")(x))
+        pooled = jnp.asarray(jnp.mean(x, axis=(1, 2)), jnp.float32)
+        logits = nn.Dense(self.logits_dimension, name="logits")(pooled)
+        return Subnetwork(
+            last_layer=pooled,
+            logits=logits,
+            complexity=float(total_blocks) ** 0.5,
+            # Numeric-only shared state (strings are not jit-traceable
+            # pytree leaves): the compound-scaling coefficients identify
+            # the variant for next-iteration generators.
+            shared={
+                "width_mult": width_mult,
+                "depth_mult": depth_mult,
+            },
+        )
+
+
+class EfficientNetBuilder(Builder):
+    """AdaNet builder over the EfficientNet family."""
+
+    def __init__(
+        self,
+        variant: str = "b0",
+        optimizer=None,
+        small_inputs: bool = False,
+        compute_dtype: Any = jnp.bfloat16,
+        name: str = None,
+    ):
+        import optax
+
+        self._variant = variant
+        self._optimizer = optimizer or optax.rmsprop(
+            0.016, decay=0.9, momentum=0.9
+        )
+        self._small_inputs = small_inputs
+        self._compute_dtype = compute_dtype
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name or "efficientnet_%s%s" % (
+            self._variant,
+            "_small" if self._small_inputs else "",
+        )
+
+    def build_subnetwork(self, logits_dimension, previous_ensemble=None):
+        return EfficientNet(
+            logits_dimension=logits_dimension,
+            variant=self._variant,
+            small_inputs=self._small_inputs,
+            compute_dtype=self._compute_dtype,
+        )
+
+    def build_train_optimizer(self, previous_ensemble=None):
+        return self._optimizer

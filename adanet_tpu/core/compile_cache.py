@@ -51,14 +51,25 @@ def arg_spec(args) -> Tuple:
 
 
 def _device_fingerprint(args) -> Tuple:
-    """Device assignment of committed args (HLO text omits devices, and an
-    executable is bound to them)."""
-    ids = set()
+    """ORDERED device assignments of committed args (HLO text omits
+    devices, and an executable is bound to them — including their order:
+    two submeshes over the same device set in different orders must not
+    collide; ADVICE r2). Distinct assignments are recorded once, in order
+    of first appearance."""
+    assignments = []
+    seen = set()
     for leaf in jax.tree_util.tree_leaves(args):
-        if isinstance(leaf, jax.Array):
-            for device in leaf.sharding.device_set:
-                ids.add(device.id)
-    return tuple(sorted(ids))
+        if not isinstance(leaf, jax.Array):
+            continue
+        sharding = leaf.sharding
+        devices = getattr(sharding, "_device_assignment", None)
+        if devices is None:
+            devices = sorted(sharding.device_set, key=lambda d: d.id)
+        ids = tuple(d.id for d in devices)
+        if ids not in seen:
+            seen.add(ids)
+            assignments.append(ids)
+    return tuple(assignments)
 
 
 class CompileCache:
@@ -89,7 +100,17 @@ class CompileCache:
             r"^module @\S+", "module @m", lowered.as_text(), count=1
         )
         digest = hashlib.sha256(text.encode()).hexdigest()
-        key = (digest, _device_fingerprint(args))
+        # Key the in/out pytree structures explicitly: current JAX embeds
+        # them in the lowered text as arg/result metadata, but executable
+        # identity must not ride on incidental text format (ADVICE r2) —
+        # returning the right buffers under the wrong treedef would be a
+        # silent output-structure corruption.
+        in_tree = jax.tree_util.tree_structure(args)
+        try:
+            out_tree = jax.tree_util.tree_structure(lowered.out_info)
+        except Exception:  # out_info unavailable on exotic stages
+            out_tree = None
+        key = (digest, _device_fingerprint(args), in_tree, out_tree)
         executable = self._executables.get(key)
         if executable is None:
             executable = lowered.compile()
@@ -122,6 +143,7 @@ class CachedStep:
     def __call__(self, *args):
         if self._cache is None:
             return self._jit(*args)
+        failed = original_error = None
         if self._last is not None:
             # Optimistic dispatch: steps are called with a stable spec, so
             # skip the per-call pytree flatten. The executable validates
@@ -130,12 +152,18 @@ class CachedStep:
             # which case we fall through to the full lookup.
             try:
                 return self._last(*args)
-            except (TypeError, ValueError):
-                pass
+            except (TypeError, ValueError) as exc:
+                failed, original_error = self._last, exc
         spec = arg_spec(args)
         executable = self._by_spec.get(spec)
         if executable is None:
             executable = self._cache.compile(self._jit, *args)
             self._by_spec[spec] = executable
+        if executable is failed:
+            # The full lookup resolved to the very executable that just
+            # failed: the error is genuine (e.g. a donated buffer reused),
+            # not a spec change — surface the original diagnostic instead
+            # of a confusing secondary failure (ADVICE r2).
+            raise original_error
         self._last = executable
         return executable(*args)

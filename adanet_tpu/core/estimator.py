@@ -274,15 +274,26 @@ class Estimator:
         # gradient all-reduces over ICI/DCN. Filesystem writes stay
         # chief-only; the manifest handshake is the iteration barrier.
         if jax.process_count() > 1:
-            if self._placement_strategy is not None:
+            if self._placement_strategy is not None and not isinstance(
+                self._placement_strategy, RoundRobinStrategy
+            ):
                 raise ValueError(
-                    "RoundRobin placement is in-process candidate "
-                    "parallelism; with multiple JAX processes use the "
-                    "default placement (multi-host SPMD data parallelism)."
+                    "Unsupported placement strategy %r for multi-process "
+                    "training; use RoundRobinStrategy (cross-process "
+                    "candidate parallelism) or the default placement "
+                    "(multi-host SPMD data parallelism)."
+                    % (self._placement_strategy,)
                 )
+            # The full process-spanning mesh: the data plane for default
+            # SPMD training, and the replicated bookkeeping substrate for
+            # multi-host RoundRobin (training itself runs on candidate
+            # submeshes; see distributed/multihost.py).
             self._spmd_mesh = data_parallel_mesh()
             _LOG.info(
-                "Multi-host SPMD: %d processes, %d global devices.",
+                "Multi-host %s: %d processes, %d global devices.",
+                "RoundRobin"
+                if self._placement_strategy is not None
+                else "SPMD",
                 jax.process_count(),
                 len(jax.devices()),
             )
@@ -371,6 +382,37 @@ class Estimator:
         )
         return bool(np.max(flags))
 
+    def _stop_check_interval(self) -> int:
+        """Steps between collective stop checks inside the training loop.
+
+        Under SPMD the agreement is a blocking host DCN round-trip; at
+        iterations_per_loop=1 checking every window would add one
+        round-trip per training step (ADVICE r2). Align the cadence with
+        the logging period, capped at 64 windows so preemption-triggered
+        mid-iteration checkpointing stays prompt even under sparse logging
+        (a SIGTERM grace window must not wait out log_every_steps=5000).
+        """
+        interval = self._log_every_steps or 8 * self._iterations_per_loop
+        return max(
+            self._iterations_per_loop,
+            min(interval, 64 * self._iterations_per_loop),
+        )
+
+    def _should_stop_at(self, steps_done: int) -> bool:
+        """In-loop stop check, deterministic across processes.
+
+        Single-process: the local flag, every window. Under SPMD: the
+        collective agreement, but only when `steps_done` crosses the check
+        cadence — every process evaluates the same arithmetic on the same
+        `steps_done`, so they enter the allgather together or not at all.
+        """
+        if self._spmd_mesh is None:
+            return self._stop_requested
+        if steps_done - self._last_stop_check_step < self._stop_check_interval():
+            return False
+        self._last_stop_check_step = steps_done
+        return self._should_stop()
+
     def _train_loop(
         self, input_fn, max_steps, info, data_iter, cached_previous
     ):
@@ -393,10 +435,25 @@ class Estimator:
             )
             executor = None
             if isinstance(self._placement_strategy, RoundRobinStrategy):
-                executor = RoundRobinExecutor(
-                    iteration, self._placement_strategy
-                )
-            state = self._init_or_restore_state(iteration, sample_batch, info)
+                if jax.process_count() > 1:
+                    # Pod-scale candidate parallelism: groups of whole
+                    # processes (or process-local device partitions) per
+                    # candidate (reference:
+                    # adanet/distributed/placement.py:134-320).
+                    from adanet_tpu.distributed.multihost import (
+                        MultiHostRoundRobinExecutor,
+                    )
+
+                    executor = MultiHostRoundRobinExecutor(
+                        iteration, self._placement_strategy
+                    )
+                else:
+                    executor = RoundRobinExecutor(
+                        iteration, self._placement_strategy
+                    )
+            state = self._init_or_restore_state(
+                iteration, sample_batch, info, replicate=(executor is None)
+            )
             if executor is not None:
                 state = executor.place(state)
 
@@ -431,9 +488,10 @@ class Estimator:
             )
             profiling = False
             profiled = False
+            self._last_stop_check_step = steps_done
             while (
                 steps_done < self._max_iteration_steps
-                and not self._should_stop()
+                and not self._should_stop_at(steps_done)
                 and (max_steps is None or info.global_step < max_steps)
             ):
                 if (
@@ -524,7 +582,11 @@ class Estimator:
                     )
                     and coordination.is_chief()
                 ):
-                    emas = iteration.ema_losses(state)
+                    emas = (
+                        executor.ema_losses(state)
+                        if executor is not None
+                        else iteration.ema_losses(state)
+                    )
                     _LOG.info(
                         "iteration %d step %d/%d adanet_loss EMAs: %s",
                         t,
@@ -535,16 +597,21 @@ class Estimator:
                     self._write_train_summaries(
                         iteration, metrics, emas, info.global_step, state
                     )
-                if (
-                    self._save_checkpoint_steps
-                    and _crossed(
-                        prev_steps_done,
-                        steps_done,
-                        self._save_checkpoint_steps,
-                    )
-                    and coordination.is_chief()
+                if self._save_checkpoint_steps and _crossed(
+                    prev_steps_done,
+                    steps_done,
+                    self._save_checkpoint_steps,
                 ):
-                    self._save_iteration_state(info, t, state)
+                    if executor is not None and executor.is_multihost:
+                        # State pieces live on different processes'
+                        # submeshes: every process joins the collective
+                        # gather at this deterministic boundary; only the
+                        # chief persists.
+                        host_state = executor.gather(state)
+                        if coordination.is_chief():
+                            self._save_iteration_state(info, t, host_state)
+                    elif coordination.is_chief():
+                        self._save_iteration_state(info, t, state)
 
             if profiling:
                 jax.profiler.stop_trace()
@@ -553,7 +620,12 @@ class Estimator:
             if executor is not None:
                 # Bookkeeping (selection/eval/freeze) runs replicated, as
                 # the reference forces ReplicationStrategy outside training.
+                # Under multi-host RoundRobin this is a collective: every
+                # process receives every group's state over DCN, then the
+                # bookkeeping programs run replicated over the full mesh.
                 state = executor.gather(state)
+                if self._spmd_mesh is not None:
+                    state = replicate_state(state, self._spmd_mesh)
 
             if steps_done < self._max_iteration_steps:
                 # Interrupted (max_steps budget or SIGTERM): persist the
@@ -897,7 +969,9 @@ class Estimator:
             return batch
         return global_batch(batch, self._spmd_mesh, stacked=stacked)
 
-    def _init_or_restore_state(self, iteration, sample_batch, info):
+    def _init_or_restore_state(
+        self, iteration, sample_batch, info, replicate: bool = True
+    ):
         state = iteration.init_state(
             self._iteration_rng(iteration.iteration_number), sample_batch
         )
@@ -909,7 +983,7 @@ class Estimator:
                 "Restored mid-iteration state from %s",
                 info.iteration_state_file,
             )
-        if self._spmd_mesh is not None:
+        if self._spmd_mesh is not None and replicate:
             # Replicate over the process-spanning mesh. Initialization is
             # deterministic (same seed, same shapes on every process), so
             # each process contributes an identical value.

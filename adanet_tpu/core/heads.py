@@ -264,9 +264,11 @@ class MultiClassHead(Head):
         self._n_classes = n_classes
         if top_k is None:
             top_k = 5 if n_classes > 5 else 0
-        if top_k < 0 or top_k >= n_classes:
+        # k == n_classes is permitted (the metric is trivially 1.0),
+        # matching tf.math.in_top_k semantics (ADVICE r2).
+        if top_k < 0 or top_k > n_classes:
             raise ValueError(
-                "top_k=%d must be in [0, n_classes=%d)" % (top_k, n_classes)
+                "top_k=%d must be in [0, n_classes=%d]" % (top_k, n_classes)
             )
         self._top_k = int(top_k)
 

@@ -11,6 +11,10 @@ from adanet_tpu.distributed.coordination import (
     wait_for_iteration,
 )
 from adanet_tpu.distributed.executor import RoundRobinExecutor
+from adanet_tpu.distributed.multihost import (
+    MultiHostRoundRobinExecutor,
+    multihost_candidate_groups,
+)
 from adanet_tpu.distributed.mesh import (
     batch_sharding,
     candidate_submeshes,
@@ -28,7 +32,9 @@ from adanet_tpu.distributed.placement import (
 )
 
 __all__ = [
+    "MultiHostRoundRobinExecutor",
     "PlacementStrategy",
+    "multihost_candidate_groups",
     "ReplicationStrategy",
     "RoundRobinExecutor",
     "RoundRobinStrategy",

@@ -53,6 +53,8 @@ class RoundRobinExecutor:
     unchanged after `gather()`.
     """
 
+    is_multihost = False
+
     def __init__(
         self,
         iteration: Iteration,
@@ -70,11 +72,7 @@ class RoundRobinExecutor:
 
         n = len(iteration.subnetwork_specs)
         self._n = n
-        self._sub_meshes = {
-            spec.name: self.strategy.subnetwork_mesh(n, i)
-            for i, spec in enumerate(iteration.subnetwork_specs)
-        }
-        self._ens_mesh = self.strategy.ensemble_mesh(n)
+        self._build_meshes()
 
         # Builders with custom training losses need the distillation
         # teacher signals; their groups hold a copy of the frozen members
@@ -251,6 +249,16 @@ class RoundRobinExecutor:
         self._ens_multi_step = CachedStep(
             ens_multi_step, compile_cache, donate_argnums=(0, 1)
         )
+
+    def _build_meshes(self) -> None:
+        """Computes the per-group submeshes (overridden by the multi-host
+        executor, which partitions the process-spanning device set)."""
+        n = self._n
+        self._sub_meshes = {
+            spec.name: self.strategy.subnetwork_mesh(n, i)
+            for i, spec in enumerate(self.iteration.subnetwork_specs)
+        }
+        self._ens_mesh = self.strategy.ensemble_mesh(n)
 
     # ------------------------------------------------------------------ state
 

@@ -1,0 +1,629 @@
+"""Multi-host RoundRobin: candidate parallelism across JAX processes.
+
+The pod-scale realization of the reference `RoundRobinStrategy`
+(reference: adanet/distributed/placement.py:134-320). The reference places
+distinct subnetworks on distinct *worker processes* (worker task index
+modulo `num_subnetworks + 1`, task 0 owning the ensembles) coordinating
+through parameter servers; here the process-spanning device set is
+partitioned into `num_subnetworks + 1` candidate groups:
+
+- With `process_count >= num_groups`, groups are contiguous blocks of
+  WHOLE processes (`np.array_split` over process indices, the analogue of
+  the reference's worker partitioning, placement.py:196-254); a group
+  spanning several processes trains its candidate with synchronous data
+  parallelism over its own cross-process submesh — the jitted step is a
+  collective program dispatched by exactly the owning processes, with
+  gradient all-reduces riding ICI within a host and DCN across hosts.
+- With fewer processes than groups, groups are assigned to processes
+  round-robin (`group_index % process_count`, exactly the reference's
+  worker-modulo rule) and each process partitions its LOCAL devices among
+  the groups it owns.
+
+Either way the ensemble group (group 0) always contains process 0 — the
+chief — so selection EMAs and bookkeeping artifacts live where the writes
+happen, matching the reference's "task 0 builds/trains ensembles" rule.
+
+Member-parameter sync — the reference's O(m*n/k) parameter-server fetches
+(placement.py:141-148) — is a host-mediated broadcast over DCN: every
+`sync_every` steps each subnetwork group's first owner broadcasts its
+replicated parameters to all processes (`multihost_utils.
+broadcast_one_to_all`), and ensemble-group owners place them onto the
+ensemble submesh. Between sync points the groups run fully independently
+(async dispatch), so staleness semantics match the in-process executor
+(see `executor.py`'s staleness contract).
+
+Data semantics match the reference, where each worker runs its own input
+pipeline: every process feeds its LOCAL batch; a group's effective
+training batch is the concatenation of its owning processes' local
+batches. Feeding every process identical batches reproduces the fused
+single-program trajectory for the subnetworks exactly (asserted by
+tests/test_distributed.py's multi-host RoundRobin oracle test).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from adanet_tpu.core.iteration import Iteration, IterationState
+from adanet_tpu.distributed import mesh as mesh_lib
+from adanet_tpu.distributed.executor import RoundRobinExecutor
+from adanet_tpu.distributed.placement import RoundRobinStrategy
+
+
+def multihost_candidate_groups(
+    num_groups: int,
+    devices: Optional[Sequence] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[List[List], List[List[int]]]:
+    """Partitions the global device set into process-aligned groups.
+
+    Returns `(groups, owners)`: `groups[g]` is the device list of group g
+    and `owners[g]` the sorted process indices owning those devices. Group
+    0 (the ensemble group) always contains process 0. A group never spans
+    a *fraction* of two processes: it is either a block of whole processes
+    or a subset of one process's local devices, so per-device batch shards
+    stay uniform (reference worker partitioning:
+    adanet/distributed/placement.py:196-254).
+    """
+    if num_groups <= 0:
+        raise ValueError("num_groups must be positive.")
+    devices = list(devices) if devices is not None else jax.devices()
+    num_processes = (
+        process_count if process_count is not None else jax.process_count()
+    )
+    by_process: Dict[int, List] = {}
+    for d in devices:
+        by_process.setdefault(d.process_index, []).append(d)
+    process_ids = sorted(by_process)
+    if len(process_ids) < num_processes and num_processes > 1:
+        # A device list that misses processes would be computed
+        # differently on each process (e.g. RoundRobinStrategy(
+        # devices=jax.local_devices())): divergent ownership maps mean
+        # several processes believe they are a broadcast source, and
+        # broadcast_one_to_all SUMS multi-source payloads — silent
+        # parameter corruption. Fail loudly instead.
+        raise ValueError(
+            "Multi-host RoundRobin needs a device list covering every "
+            "process identically: got devices from processes %s but "
+            "process_count=%d. Use RoundRobinStrategy() with the default "
+            "(global) device list under multi-process training."
+            % (process_ids, num_processes)
+        )
+    num_processes = len(process_ids)
+
+    groups: List[List] = [[] for _ in range(num_groups)]
+    owners: List[List[int]] = [[] for _ in range(num_groups)]
+    if num_processes >= num_groups:
+        # Whole-process blocks (contiguous, chief in group 0).
+        for g, block in enumerate(
+            np.array_split(np.asarray(process_ids), num_groups)
+        ):
+            for p in block.tolist():
+                groups[g].extend(by_process[p])
+                owners[g].append(p)
+    else:
+        # Reference worker-modulo rule: group g -> process g % P; each
+        # process splits its local devices among the groups it owns.
+        owned_by: Dict[int, List[int]] = {}
+        for g in range(num_groups):
+            p = process_ids[g % num_processes]
+            owned_by.setdefault(p, []).append(g)
+        for p, group_ids in owned_by.items():
+            parts = mesh_lib.partition_devices(
+                by_process[p], len(group_ids)
+            )
+            for g, part in zip(group_ids, parts):
+                groups[g] = list(part)
+                owners[g] = [p]
+    return groups, owners
+
+
+def _fetch_replicated(tree):
+    """Host copy of a pytree whose arrays are replicated over a (possibly
+    non-fully-addressable) submesh this process participates in."""
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_shards[0].data)
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(fetch, tree)
+
+
+class MultiHostRoundRobinExecutor(RoundRobinExecutor):
+    """RoundRobin candidate parallelism over a multi-process device set.
+
+    Reuses the in-process executor's jitted per-group programs unchanged;
+    only placement, batch assembly, member sync, and gather know about
+    processes. Degenerates gracefully to the in-process behavior with one
+    process (used by the driver dry-run).
+    """
+
+    is_multihost = True
+
+    def __init__(
+        self,
+        iteration: Iteration,
+        strategy: Optional[RoundRobinStrategy] = None,
+        sync_every: int = 1,
+    ):
+        self._process_index = jax.process_index()
+        self._process_count = jax.process_count()
+        super().__init__(iteration, strategy, sync_every=sync_every)
+        # Host-side template of every state piece (zeros-shaped exactly as
+        # the live values): non-owned pieces keep their template so the
+        # state pytree structure is identical on every process.
+        self._host_template: Optional[IterationState] = None
+        self._synced_losses: Dict[str, np.ndarray] = {}
+        self._last_local_losses: Dict[str, np.ndarray] = {}
+
+    # ------------------------------------------------------------- topology
+
+    def _build_meshes(self) -> None:
+        devices = None
+        if self.strategy is not None and self.strategy._devices is not None:
+            devices = self.strategy._devices
+        groups, owners = multihost_candidate_groups(
+            self._n + 1, devices=devices
+        )
+        self._groups = groups
+        self._owners = owners
+        self._ens_mesh = mesh_lib.data_parallel_mesh(groups[0])
+        self._sub_meshes = {
+            spec.name: mesh_lib.data_parallel_mesh(groups[1 + i])
+            for i, spec in enumerate(self.iteration.subnetwork_specs)
+        }
+
+    def _group_index(self, spec_name: Optional[str]) -> int:
+        """Group id: 0 for the ensemble, 1+i for subnetwork i."""
+        if spec_name is None:
+            return 0
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            if spec.name == spec_name:
+                return 1 + i
+        raise KeyError(spec_name)
+
+    def _owns(self, group_index: int) -> bool:
+        return self._process_index in self._owners[group_index]
+
+    @property
+    def owns_ensemble(self) -> bool:
+        return self._owns(0)
+
+    def owned_groups(self) -> List[int]:
+        return [
+            g
+            for g in range(self._n + 1)
+            if self._process_index in self._owners[g]
+        ]
+
+    # ---------------------------------------------------------------- place
+
+    def place(self, state: IterationState) -> IterationState:
+        """Places each state piece on its group's submesh (owners only).
+
+        `state` must be host-resident and identical on every process
+        (deterministic init / checkpoint restore). Non-owned pieces stay
+        as host templates so the pytree structure matches everywhere.
+        """
+        state = jax.device_get(state)
+        self._host_template = state
+
+        sub_states = {}
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            g = 1 + i
+            if self._owns(g):
+                sub_states[spec.name] = mesh_lib.replicate_state(
+                    state.subnetworks[spec.name], self._sub_meshes[spec.name]
+                )
+            else:
+                sub_states[spec.name] = state.subnetworks[spec.name]
+
+        if self.owns_ensemble:
+            ens = mesh_lib.replicate_state(state.ensembles, self._ens_mesh)
+            cands = mesh_lib.replicate_state(
+                state.candidates, self._ens_mesh
+            )
+            frozen = mesh_lib.replicate_state(state.frozen, self._ens_mesh)
+        else:
+            ens, cands, frozen = (
+                state.ensembles,
+                state.candidates,
+                state.frozen,
+            )
+
+        # Teacher copies for context-needing groups (see executor.py).
+        prev_name = (
+            self.iteration.ensemble_specs[0].name
+            if self.iteration.previous_ensemble is not None
+            else None
+        )
+        for name, needs in self._needs_context.items():
+            if not needs or not self._owns(self._group_index(name)):
+                continue
+            mesh = self._sub_meshes[name]
+            self._sub_frozen[name] = mesh_lib.replicate_state(
+                state.frozen, mesh
+            )
+            self._sub_prev_params[name] = mesh_lib.replicate_state(
+                state.ensembles[prev_name].params, mesh
+            )
+
+        return IterationState(
+            subnetworks=sub_states,
+            ensembles=ens,
+            candidates=cands,
+            frozen=frozen,
+            iteration_step=state.iteration_step,
+            rng=state.rng,
+        )
+
+    # ----------------------------------------------------------- batch plane
+
+    def _group_batch(self, batch, group_index: int, stacked: bool = False):
+        """This group's training batch from the process-local batch.
+
+        Single-owner groups shard the local batch over their (local)
+        submesh; multi-owner groups concatenate the owning processes'
+        local batches along the batch axis (each process contributes the
+        rows it already holds — no cross-host data transfer), exactly the
+        multi-host SPMD data path of `mesh_lib.global_batch` scoped to the
+        group's submesh.
+        """
+        mesh = (
+            self._ens_mesh
+            if group_index == 0
+            else self._sub_meshes[
+                self.iteration.subnetwork_specs[group_index - 1].name
+            ]
+        )
+        owners = self._owners[group_index]
+        if len(owners) == 1:
+            return mesh_lib.shard_batch(batch, mesh, stacked=stacked)
+
+        batch_axis = 1 if stacked else 0
+        spec = [None] * batch_axis + ["data"]
+        sharded = NamedSharding(mesh, PartitionSpec(*spec))
+        replica = NamedSharding(mesh, PartitionSpec())
+        n_local = sum(
+            1
+            for d in mesh.devices.flatten()
+            if d.process_index == self._process_index
+        )
+
+        def put(x):
+            arr = np.asarray(x)
+            if arr.ndim <= batch_axis:
+                return jax.device_put(arr, replica)
+            if n_local and arr.shape[batch_axis] % n_local != 0:
+                raise ValueError(
+                    "Multi-host RoundRobin requires the per-process batch "
+                    "dimension (%d) to be divisible by this process's %d "
+                    "devices in candidate group %d; adjust the batch size."
+                    % (arr.shape[batch_axis], n_local, group_index)
+                )
+            global_shape = list(arr.shape)
+            global_shape[batch_axis] *= len(owners)
+            return jax.make_array_from_process_local_data(
+                sharded, arr, tuple(global_shape)
+            )
+
+        return jax.tree_util.tree_map(put, batch)
+
+    # -------------------------------------------------------------- syncing
+
+    def _broadcast_from_group(
+        self, group_index: int, payload_if_owner, template_if_not
+    ):
+        """Broadcasts a host pytree from the group's first owner to all
+        processes (the DCN leg of the PS-fetch analogue).
+
+        `payload_if_owner` is evaluated only on owning processes;
+        `template_if_not` builds a zeros pytree of the SAME structure on
+        the others (broadcast is a psum of source data with zeros, so the
+        structures must match exactly). Both are zero-arg callables."""
+        src = self._owners[group_index][0]
+        if self._process_count == 1:
+            return payload_if_owner()
+        from jax.experimental import multihost_utils
+
+        if self._owns(group_index):
+            payload = payload_if_owner()
+        else:
+            payload = jax.tree_util.tree_map(
+                np.zeros_like, template_if_not()
+            )
+        return multihost_utils.broadcast_one_to_all(
+            payload, is_source=(self._process_index == src)
+        )
+
+    def _maybe_sync_members(self, new_subnetworks) -> None:
+        """Member-parameter sync across processes.
+
+        All processes rendezvous at the same deterministic step
+        boundaries (`sync_every`); each subnetwork group's variables (and
+        its latest training-loss scalar, for chief-side logging) broadcast
+        from the group's first owner; ensemble-group owners then place the
+        variables onto the ensemble submesh.
+        """
+        if (
+            self._member_vars_cache is not None
+            and self._host_step - self._last_sync_step < self.sync_every
+        ):
+            return
+        self._last_sync_step = self._host_step
+        member_vars = {}
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            g = 1 + i
+            name = spec.name
+
+            def local_payload(n=name):
+                # Losses stay device arrays until this sync boundary, so
+                # the per-step dispatch loop never blocks on a host fetch
+                # (the base executor's async-dispatch contract).
+                st = new_subnetworks[n]
+                loss = self._last_local_losses.get(n)
+                loss = (
+                    np.zeros((), np.float32)
+                    if loss is None
+                    else np.asarray(_fetch_replicated(loss), np.float32)
+                )
+                return (_fetch_replicated(st.variables), loss)
+
+            def template(n=name):
+                return (
+                    self._host_template.subnetworks[n].variables,
+                    np.zeros((), np.float32),
+                )
+
+            host_vars, loss = self._broadcast_from_group(
+                g, local_payload, template
+            )
+            if not self._owns(g):
+                self._synced_losses["subnetwork_loss/%s" % name] = loss
+            if self.owns_ensemble:
+                member_vars[name] = mesh_lib.replicate_state(
+                    host_vars, self._ens_mesh
+                )
+        if self.owns_ensemble:
+            self._member_vars_cache = member_vars
+        else:
+            # Marks the sync as done for cadence accounting.
+            self._member_vars_cache = self._member_vars_cache or {}
+
+    # ---------------------------------------------------------------- train
+
+    def train_step(self, state: IterationState, batch):
+        """One candidate-parallel step; `batch` is this process's LOCAL
+        batch. Owning processes dispatch their groups' programs; the
+        ensemble group additionally runs every mixture-weight update."""
+        features, labels = batch
+        rng, step_rng = jax.random.split(state.rng)
+
+        new_subnetworks = dict(state.subnetworks)
+        metrics: Dict[str, np.ndarray] = {}
+        self._last_local_losses = {}
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            g = 1 + i
+            if not self._owns(g):
+                continue
+            sub_batch = self._group_batch((features, labels), g)
+            rng_i = jax.random.fold_in(step_rng, i)
+            if self._needs_context[spec.name]:
+                new_st, loss, extra = self._sub_steps[spec.name](
+                    state.subnetworks[spec.name],
+                    self._sub_frozen[spec.name],
+                    self._sub_prev_params[spec.name],
+                    sub_batch[0],
+                    sub_batch[1],
+                    rng_i,
+                )
+            else:
+                new_st, loss, extra = self._sub_steps[spec.name](
+                    state.subnetworks[spec.name],
+                    sub_batch[0],
+                    sub_batch[1],
+                    rng_i,
+                )
+            new_subnetworks[spec.name] = new_st
+            # Keep the loss a device array: the host fetch happens only at
+            # sync boundaries, preserving async dispatch across groups.
+            self._last_local_losses[spec.name] = loss
+            metrics["subnetwork_loss/%s" % spec.name] = loss
+            metrics.update(extra)
+
+        self._host_step += 1
+        self._maybe_sync_members(new_subnetworks)
+        metrics.update(self._synced_losses)
+
+        if self.owns_ensemble:
+            ens_batch = self._group_batch((features, labels), 0)
+            new_ens, new_cands, ens_metrics = self._ens_step(
+                state.ensembles,
+                state.candidates,
+                state.frozen,
+                self._member_vars_cache,
+                ens_batch[0],
+                ens_batch[1],
+            )
+            metrics.update(ens_metrics)
+        else:
+            new_ens, new_cands = state.ensembles, state.candidates
+
+        new_state = IterationState(
+            subnetworks=new_subnetworks,
+            ensembles=new_ens,
+            candidates=new_cands,
+            frozen=state.frozen,
+            iteration_step=state.iteration_step + 1,
+            rng=rng,
+        )
+        return new_state, metrics
+
+    def train_steps(self, state: IterationState, stacked_batch):
+        """K steps per dispatch (`iterations_per_loop`), multi-host: each
+        owned group scans its K steps on its submesh; members sync once
+        per window (staleness = max(sync_every, K), as in-process)."""
+        features, labels = stacked_batch
+        k = int(jax.tree_util.tree_leaves(features)[0].shape[0])
+        rng = state.rng
+        step_rngs = []
+        for _ in range(k):
+            rng, step_rng = jax.random.split(rng)
+            step_rngs.append(step_rng)
+        import jax.numpy as jnp
+
+        step_rngs = jnp.stack(step_rngs)
+
+        new_subnetworks = dict(state.subnetworks)
+        metrics: Dict[str, np.ndarray] = {}
+        self._last_local_losses = {}
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            g = 1 + i
+            if not self._owns(g):
+                continue
+            sub_batch = self._group_batch(
+                (features, labels), g, stacked=True
+            )
+            keys_i = jax.vmap(
+                lambda key, index=i: jax.random.fold_in(key, index)
+            )(step_rngs)
+            if self._needs_context[spec.name]:
+                new_st, loss, extra = self._sub_multi_steps[spec.name](
+                    state.subnetworks[spec.name],
+                    self._sub_frozen[spec.name],
+                    self._sub_prev_params[spec.name],
+                    sub_batch,
+                    keys_i,
+                )
+            else:
+                new_st, loss, extra = self._sub_multi_steps[spec.name](
+                    state.subnetworks[spec.name], sub_batch, keys_i
+                )
+            new_subnetworks[spec.name] = new_st
+            # Keep the loss a device array: the host fetch happens only at
+            # sync boundaries, preserving async dispatch across groups.
+            self._last_local_losses[spec.name] = loss
+            metrics["subnetwork_loss/%s" % spec.name] = loss
+            metrics.update(extra)
+
+        self._host_step += k
+        self._maybe_sync_members(new_subnetworks)
+        metrics.update(self._synced_losses)
+
+        if self.owns_ensemble:
+            ens_batch = self._group_batch(
+                (features, labels), 0, stacked=True
+            )
+            new_ens, new_cands, ens_metrics = self._ens_multi_step(
+                state.ensembles,
+                state.candidates,
+                state.frozen,
+                self._member_vars_cache,
+                ens_batch,
+            )
+            metrics.update(ens_metrics)
+        else:
+            new_ens, new_cands = state.ensembles, state.candidates
+
+        return (
+            IterationState(
+                subnetworks=new_subnetworks,
+                ensembles=new_ens,
+                candidates=new_cands,
+                frozen=state.frozen,
+                iteration_step=state.iteration_step + k,
+                rng=rng,
+            ),
+            metrics,
+        )
+
+    def ema_losses(self, state):
+        """Candidate EMAs for chief-side logging.
+
+        The candidate states live on the ensemble submesh, which may span
+        several processes; the chief fetches its local replica and
+        computes the debiased EMA on host so a single-process caller never
+        launches an eager collective on a cross-process array."""
+        from adanet_tpu.core import candidate as candidate_lib
+
+        host = _fetch_replicated(state.candidates)
+        return {
+            name: float(
+                candidate_lib.debiased_ema(
+                    cstate, self.iteration.adanet_loss_decay
+                )
+            )
+            for name, cstate in host.items()
+        }
+
+    # --------------------------------------------------------------- gather
+
+    def gather(self, state: IterationState) -> IterationState:
+        """Full state to host on EVERY process (collective): subnetwork
+        states broadcast from their group owners, ensemble/candidate state
+        from the ensemble group — bookkeeping then proceeds replicated, as
+        the reference forces ReplicationStrategy outside training."""
+        if self._host_template is None:
+            return jax.device_get(state)
+
+        sub_states = {}
+        for i, spec in enumerate(self.iteration.subnetwork_specs):
+            g = 1 + i
+            name = spec.name
+            src = self._owners[g][0]
+            if self._process_count == 1:
+                sub_states[name] = _fetch_replicated(
+                    state.subnetworks[name]
+                )
+                continue
+            from jax.experimental import multihost_utils
+
+            if self._owns(g):
+                payload = _fetch_replicated(state.subnetworks[name])
+            else:
+                payload = jax.tree_util.tree_map(
+                    np.zeros_like, self._host_template.subnetworks[name]
+                )
+            sub_states[name] = multihost_utils.broadcast_one_to_all(
+                payload, is_source=(self._process_index == src)
+            )
+
+        if self._process_count == 1:
+            ens = _fetch_replicated(state.ensembles)
+            cands = _fetch_replicated(state.candidates)
+        else:
+            from jax.experimental import multihost_utils
+
+            if self.owns_ensemble:
+                payload = (
+                    _fetch_replicated(state.ensembles),
+                    _fetch_replicated(state.candidates),
+                )
+            else:
+                payload = jax.tree_util.tree_map(
+                    np.zeros_like,
+                    (
+                        self._host_template.ensembles,
+                        self._host_template.candidates,
+                    ),
+                )
+            ens, cands = multihost_utils.broadcast_one_to_all(
+                payload,
+                is_source=(self._process_index == self._owners[0][0]),
+            )
+
+        # Frozen members never train: every process holds the identical
+        # host copy it initialized with.
+        return IterationState(
+            subnetworks=sub_states,
+            ensembles=ens,
+            candidates=cands,
+            frozen=self._host_template.frozen,
+            iteration_step=_fetch_replicated(state.iteration_step),
+            rng=state.rng,
+        )

@@ -1,0 +1,126 @@
+"""Multi-host RoundRobin runner: one OS process per JAX process.
+
+Spawned by `test_distributed.py::test_multi_host_round_robin_*` with a
+shared model_dir, process id/count, device count, and coordinator port —
+the pod-scale candidate-parallelism analogue of the reference's
+round_robin TF_CONFIG grid
+(reference: adanet/core/estimator_distributed_test.py:198-280).
+
+Every process feeds IDENTICAL full batches, so each candidate group —
+wherever its submesh lives — trains on the same data as a fused
+single-process oracle (a multi-owner group sees the rows duplicated once
+per owner, which leaves every mean-loss gradient unchanged). The test
+then asserts the frozen winner's member parameters match the oracle's.
+
+Each process writes `probe_<pid>.npz` with the frozen winner's member
+parameters (workers compute them with write=False via the collective
+bookkeeping path), plus the group→process ownership map it observed.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def full_batches():
+    """Deterministic global batches (16 rows each)."""
+    rng = np.random.RandomState(11)
+    batches = []
+    for _ in range(4):
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x @ np.ones((4, 1), np.float32)) + 0.1
+        batches.append(({"x": x}, y))
+    return batches
+
+
+def main():
+    model_dir = sys.argv[1]
+    process_id = int(sys.argv[2])
+    num_processes = int(sys.argv[3])
+    local_devices = int(sys.argv[4])
+    port = sys.argv[5]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", local_devices)
+    jax.distributed.initialize(
+        coordinator_address="localhost:%s" % port,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes
+    assert len(jax.devices()) == num_processes * local_devices
+
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.distributed import (
+        RoundRobinStrategy,
+        multihost_candidate_groups,
+    )
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder
+
+    # Record the ownership topology for the test to assert on.
+    groups, owners = multihost_candidate_groups(3)
+    topology = {
+        "owners": owners,
+        "group_sizes": [len(g) for g in groups],
+    }
+
+    def input_fn():
+        return iter(full_batches())
+
+    probes = {}
+
+    class ProbeEstimator(adanet_tpu.Estimator):
+        def _complete_iteration(self, iteration, state, *args, **kwargs):
+            frozen = super()._complete_iteration(
+                iteration, state, *args, **kwargs
+            )
+            flat, _ = jax.tree_util.tree_flatten(
+                [
+                    ws.subnetwork.params
+                    for ws in frozen.weighted_subnetworks
+                ]
+            )
+            for i, leaf in enumerate(flat):
+                probes["t%d_leaf%d" % (frozen.iteration_number, i)] = (
+                    np.asarray(leaf)
+                )
+            return frozen
+
+    est = ProbeEstimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+        ),
+        max_iteration_steps=6,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        max_iterations=2,
+        model_dir=model_dir,
+        log_every_steps=0,
+        placement_strategy=RoundRobinStrategy(),
+    )
+    est.train(input_fn, max_steps=100)
+    assert est.latest_iteration_number() == 2
+
+    np.savez(
+        os.path.join(model_dir, "probe_%d.npz" % process_id), **probes
+    )
+    with open(
+        os.path.join(model_dir, "topology_%d.json" % process_id), "w"
+    ) as f:
+        json.dump(topology, f)
+    print("MHRR ROLE %d DONE" % process_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -314,6 +314,187 @@ def test_graft_dryrun_self_provisions_virtual_mesh():
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
 
 
+def test_multihost_executor_degenerate_single_process():
+    """The multi-host RoundRobin executor with one process partitions the
+    local devices (reference worker-modulo rule) and trains identically to
+    usable selection/freeze state — the driver dry-run path."""
+    from adanet_tpu.distributed import (
+        MultiHostRoundRobinExecutor,
+        multihost_candidate_groups,
+    )
+
+    groups, owners = multihost_candidate_groups(3)
+    assert [len(g) for g in groups] == [3, 3, 2]
+    assert owners == [[0], [0], [0]]
+
+    factory = IterationBuilder(
+        head=RegressionHead(),
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        ensemble_strategies=[GrowStrategy()],
+    )
+    sample = next(linear_dataset()())
+    it = factory.build_iteration(
+        0, [DNNBuilder("a", 1), DNNBuilder("b", 2)], None
+    )
+    executor = MultiHostRoundRobinExecutor(it, RoundRobinStrategy())
+    assert executor.owns_ensemble
+    assert executor.owned_groups() == [0, 1, 2]
+    state = executor.place(it.init_state(jax.random.PRNGKey(0), sample))
+    first = None
+    for batch in linear_dataset()():
+        state, metrics = executor.train_step(state, batch)
+        if first is None:
+            first = float(
+                metrics["adanet_loss/t0_a_grow_complexity_regularized"]
+            )
+    last = float(metrics["adanet_loss/t0_a_grow_complexity_regularized"])
+    assert np.isfinite(last) and last < first
+    emas = executor.ema_losses(state)
+    assert all(np.isfinite(v) for v in emas.values())
+    gathered = executor.gather(state)
+    best = it.best_candidate_index(gathered)
+    frozen = it.freeze_candidate(
+        gathered, it.candidate_names()[best], sample
+    )
+    assert frozen.weighted_subnetworks
+
+
+def _run_multihost_rr(tmp_path, num_processes, local_devices):
+    """Spawns the multi-host RoundRobin grid and returns (model_dir, outs)."""
+    import socket
+    import subprocess
+    import sys
+
+    runner = os.path.join(
+        os.path.dirname(__file__), "multihost_rr_runner.py"
+    )
+    model_dir = str(tmp_path / "mhrr_model")
+    os.makedirs(model_dir)
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        port = sock.getsockname()[1]
+
+    def spawn(index):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        tests_dir = os.path.dirname(__file__)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [
+                os.path.dirname(tests_dir),
+                tests_dir,
+                env.get("PYTHONPATH", ""),
+            ]
+        )
+        return subprocess.Popen(
+            [
+                sys.executable,
+                runner,
+                model_dir,
+                str(index),
+                str(num_processes),
+                str(local_devices),
+                str(port),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    procs = [spawn(i) for i in range(num_processes)]
+    outs = []
+    for i, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=600)
+        outs.append(out)
+        assert proc.returncode == 0, (i, out.decode()[-3000:])
+        assert ("MHRR ROLE %d DONE" % i).encode() in out
+    return model_dir, outs
+
+
+def _assert_matches_fused_oracle(tmp_path, model_dir, num_processes):
+    """Asserts every process produced identical frozen params AND that the
+    final members match a fused single-process oracle on the same data
+    (the RoundRobin/fused divergence contract, now across processes)."""
+    import json
+
+    from multihost_rr_runner import full_batches
+
+    import adanet_tpu
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    probes = [
+        np.load(os.path.join(model_dir, "probe_%d.npz" % i))
+        for i in range(num_processes)
+    ]
+    assert probes[0].files
+    for other in probes[1:]:
+        assert sorted(other.files) == sorted(probes[0].files)
+        for key in probes[0].files:
+            np.testing.assert_array_equal(probes[0][key], other[key])
+
+    def oracle_input_fn():
+        return iter(full_batches())
+
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+        ),
+        max_iteration_steps=6,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        max_iterations=2,
+        model_dir=str(tmp_path / "oracle_model"),
+        log_every_steps=0,
+    )
+    est.train(oracle_input_fn, max_steps=100)
+    frozen = est._rebuild_previous_ensemble(2, next(oracle_input_fn()))
+    flat, _ = jax.tree_util.tree_flatten(
+        [ws.subnetwork.params for ws in frozen.weighted_subnetworks]
+    )
+    # Subnetwork training under RoundRobin is the fused trajectory (same
+    # batches, same updates); the winning member's params must match the
+    # oracle tightly. (Mixture weights see sync staleness and are
+    # checked by the in-process divergence-bound test.)
+    for i, oracle_leaf in enumerate(flat):
+        np.testing.assert_allclose(
+            np.asarray(oracle_leaf),
+            probes[0]["t1_leaf%d" % i],
+            rtol=2e-4,
+            atol=1e-5,
+        )
+    return [
+        json.load(
+            open(os.path.join(model_dir, "topology_%d.json" % i))
+        )
+        for i in range(num_processes)
+    ]
+
+
+def test_multi_host_round_robin_two_processes(tmp_path):
+    """VERDICT r2 #1: RoundRobin candidate parallelism across 2 JAX
+    processes. With 2 processes and 3 groups the reference worker-modulo
+    rule places the ensemble + subnetwork 'b' on process 0 and subnetwork
+    'a' on process 1; member params sync to the ensemble group over the
+    host/DCN broadcast, and the frozen winner matches the fused oracle."""
+    model_dir, _ = _run_multihost_rr(tmp_path, num_processes=2, local_devices=4)
+    topologies = _assert_matches_fused_oracle(tmp_path, model_dir, 2)
+    # Worker-modulo ownership: groups 0,2 -> process 0; group 1 -> process 1.
+    assert topologies[0]["owners"] == [[0], [1], [0]]
+    assert topologies[0] == topologies[1]
+
+
+def test_multi_host_round_robin_four_processes(tmp_path):
+    """VERDICT r2 #1 + #7: with 4 processes and 3 groups, the ensemble
+    group spans TWO whole processes — its mixture-weight training is a
+    cross-process collective program — while each subnetwork owns one
+    process. The frozen winner still matches the fused oracle."""
+    model_dir, _ = _run_multihost_rr(tmp_path, num_processes=4, local_devices=2)
+    topologies = _assert_matches_fused_oracle(tmp_path, model_dir, 4)
+    # Whole-process blocks: ensemble {0,1}, subnetworks {2} and {3}.
+    assert topologies[0]["owners"] == [[0, 1], [2], [3]]
+    assert all(t == topologies[0] for t in topologies[1:])
+
+
 def test_estimator_with_round_robin_placement(tmp_path):
     """Full Estimator lifecycle with candidate-parallel training placement."""
     import adanet_tpu

@@ -154,8 +154,14 @@ def test_multiclass_top_k_accuracy():
         jnp.asarray([[1.0, 2.0, 3.0, 4.0]]), jnp.asarray([2])
     )
     np.testing.assert_allclose(m["top_2_accuracy"], 1.0)
+    # k == n_classes is allowed (trivially 1.0), matching
+    # tf.math.in_top_k semantics (ADVICE r2); k > n_classes raises.
+    m = MultiClassHead(4, top_k=4).eval_metrics(
+        jnp.asarray([[4.0, 3.0, 2.0, 1.0]]), jnp.asarray([3])
+    )
+    np.testing.assert_allclose(m["top_4_accuracy"], 1.0)
     with pytest.raises(ValueError):
-        MultiClassHead(4, top_k=4)
+        MultiClassHead(4, top_k=5)
 
 
 def test_multiclass_head_requires_two_classes():

@@ -23,6 +23,7 @@ report JSON store.
 
 from __future__ import annotations
 
+import inspect
 import itertools
 import logging
 import os
@@ -43,6 +44,7 @@ from adanet_tpu.core.frozen import (
     FrozenSubnetwork,
     FrozenWeightedSubnetwork,
 )
+from adanet_tpu.core import iteration as iteration_lib
 from adanet_tpu.core.iteration import Iteration, IterationBuilder
 from adanet_tpu.core.report_accessor import ReportAccessor
 from adanet_tpu.core.report_materializer import ReportMaterializer
@@ -57,7 +59,11 @@ from adanet_tpu.distributed.mesh import (
 from adanet_tpu.distributed.placement import RoundRobinStrategy
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
-from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
+from adanet_tpu.utils import (
+    WeightedMeanAccumulator,
+    batch_example_count,
+    batch_metric_weight,
+)
 
 _LOG = logging.getLogger("adanet_tpu")
 
@@ -112,6 +118,11 @@ class Estimator:
       random_seed: base seed; iteration t uses fold_in(seed, t).
       save_checkpoint_steps: mid-iteration checkpoint period in steps; None
         checkpoints only at iteration boundaries.
+      weight_key: name of the per-example weight column inside the features
+        mapping (the reference's `weight_column` on canned heads,
+        ensemble_builder.py:571-583). The column is stripped before models
+        see the features; weights feed every head loss and eval metric —
+        training, Evaluator candidate scoring, and `evaluate`.
       log_every_steps: training-log period.
     """
 
@@ -144,6 +155,7 @@ class Estimator:
         placement_strategy=None,
         export_subnetwork_logits: bool = False,
         export_subnetwork_last_layer: bool = False,
+        weight_key: Optional[str] = None,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -151,6 +163,12 @@ class Estimator:
                 % (max_iteration_steps,)
             )
         self._head = head
+        # weight_column analogue (reference:
+        # adanet/core/ensemble_builder.py:571-583): when set, every
+        # features batch must be a mapping carrying this key; the column is
+        # stripped before models see the features and feeds every head
+        # loss/eval metric (training, Evaluator scoring, evaluate()).
+        self._weight_key = weight_key
         self._generator = subnetwork_generator
         self._max_iteration_steps = int(max_iteration_steps)
         self._ensemblers = list(
@@ -227,6 +245,7 @@ class Estimator:
                 self._enable_summaries and self._log_every_steps > 0
             ),
             compile_cache=self._compile_cache,
+            weight_key=weight_key,
         )
 
     # ------------------------------------------------------------ properties
@@ -465,17 +484,18 @@ class Estimator:
                 if getattr(spec.builder, "train_input_fn", None) is not None
             }
             extra_iters: Dict[str, Iterator] = {}
-            if executor is not None and extra_input_fns:
-                raise ValueError(
-                    "Per-candidate train_input_fn (bagging) is not "
-                    "supported with RoundRobinStrategy placement; use the "
-                    "default replicated placement."
-                )
-            if self._spmd_mesh is not None and extra_input_fns:
-                raise ValueError(
-                    "Per-candidate train_input_fn (bagging) is not "
-                    "supported with multi-host SPMD training."
-                )
+            # Bagging works under every execution mode, matching the
+            # reference's distributed support for per-candidate input
+            # pipelines (adanet/autoensemble/common.py:59-93):
+            # - fused/SPMD: each candidate's batch rides into the one
+            #   jitted step; under multi-host each process feeds its LOCAL
+            #   shard of every candidate's batches (global_batch per
+            #   candidate).
+            # - RoundRobin (in-process or multi-host): the owning group
+            #   trains on the candidate's own batch sharded over its
+            #   submesh; the ensemble group keeps consuming the shared
+            #   batch for member forwards, exactly like the fused path's
+            #   shared-batch recompute.
 
             steps_done = int(jax.device_get(state.iteration_step))
             _LOG.info(
@@ -517,9 +537,10 @@ class Estimator:
                     )
                 loop_size = min(self._iterations_per_loop, steps_budget)
                 prev_steps_done = steps_done
-                use_window = loop_size > 1 and (
-                    executor is not None or not extra_input_fns
-                )
+                # Bagged candidates consume their own iterator each step;
+                # windows would need per-candidate stacked streams, so
+                # bagging always dispatches single steps.
+                use_window = loop_size > 1 and not extra_input_fns
                 if use_window:
                     # K steps per dispatch: collect the window, stack it
                     # when shapes agree (one lax.scan dispatch), and fall
@@ -554,16 +575,24 @@ class Estimator:
                     info.global_step += loop_size
                 elif executor is not None:
                     batch, data_iter = self._next_batch(input_fn, data_iter)
-                    state, metrics = executor.train_step(state, batch)
+                    extra_batches = {}
+                    for name, fn in extra_input_fns.items():
+                        extra_batches[name], extra_iters[name] = (
+                            self._next_batch(fn, extra_iters.get(name))
+                        )
+                    state, metrics = executor.train_step(
+                        state, batch, extra_batches
+                    )
                     steps_done += 1
                     info.global_step += 1
                 else:
                     batch, data_iter = self._next_batch(input_fn, data_iter)
                     extra_batches = {}
                     for name, fn in extra_input_fns.items():
-                        extra_batches[name], extra_iters[name] = (
-                            self._next_batch(fn, extra_iters.get(name))
+                        raw, extra_iters[name] = self._next_batch(
+                            fn, extra_iters.get(name)
                         )
+                        extra_batches[name] = self._place_batch(raw)
                     state, metrics = iteration.train_step(
                         state, self._place_batch(batch), extra_batches
                     )
@@ -1028,7 +1057,10 @@ class Estimator:
         exclude_first = self._force_grow and t > 0
         if self._evaluator:
             values = self._evaluator.evaluate(
-                iteration, state, batch_transform=self._place_batch
+                iteration,
+                state,
+                batch_transform=self._place_batch,
+                collective=self._spmd_mesh is not None,
             )
             objective_fn = self._evaluator.objective_fn
             if exclude_first:
@@ -1089,6 +1121,7 @@ class Estimator:
                     state,
                     included,
                     batch_transform=self._place_batch,
+                    collective=self._spmd_mesh is not None,
                 )
             )
             if write:
@@ -1206,24 +1239,59 @@ class Estimator:
         first, data = self._bootstrap_input(input_fn)
         forward, params, name = self._final_forward_fn(first)
 
+        # A custom metric_fn taking (logits, labels, weights) opts into
+        # example weighting; the 2-arg form stays a plain per-batch mean
+        # and must then be cross-batch averaged by example COUNT, not by
+        # total weight (weighted head means and unweighted custom means
+        # need different combination weights).
+        metric_fn_weighted = False
+        if self._metric_fn is not None and self._weight_key is not None:
+            try:
+                metric_fn_weighted = (
+                    len(inspect.signature(self._metric_fn).parameters) >= 3
+                )
+            except (TypeError, ValueError):
+                metric_fn_weighted = False
+
         @jax.jit
         def metrics_fn(params, features, labels):
+            features, weights = iteration_lib.split_example_weights(
+                features, self._weight_key
+            )
             ensemble = forward(params, features)
-            out = dict(self._head.eval_metrics(ensemble.logits, labels))
-            out["loss"] = self._head.loss(ensemble.logits, labels)
+            out = dict(
+                self._head.eval_metrics(ensemble.logits, labels, weights)
+            )
+            out["loss"] = self._head.loss(ensemble.logits, labels, weights)
+            custom = {}
             if self._metric_fn is not None:
-                out.update(self._metric_fn(ensemble.logits, labels))
-            return out
+                if metric_fn_weighted:
+                    out.update(
+                        self._metric_fn(ensemble.logits, labels, weights)
+                    )
+                else:
+                    custom = dict(self._metric_fn(ensemble.logits, labels))
+            return out, custom
 
-        # Per-batch means weighted by example count (a ragged final batch
-        # must not be over-weighted; ADVICE round 1).
+        # Per-batch means weighted by example count — total example weight
+        # under weight_key — so a ragged final batch is not over-weighted
+        # (ADVICE round 1).
         acc = WeightedMeanAccumulator()
+        custom_acc = WeightedMeanAccumulator()
         for features, labels in self._eval_batches(data, steps):
-            n = batch_example_count((features, labels))
-            features, labels = self._place_batch((features, labels))
-            host = jax.device_get(metrics_fn(params, features, labels))
+            batch = (features, labels)
+            n = batch_metric_weight(batch, self._weight_key)
+            n_examples = batch_example_count(batch)
+            features, labels = self._place_batch(batch)
+            host, host_custom = jax.device_get(
+                metrics_fn(params, features, labels)
+            )
             acc.add(host, n)
+            if host_custom:
+                custom_acc.add(host_custom, n_examples)
         result = acc.means()
+        if custom_acc.batches:
+            result.update(custom_acc.means())
         self._write_eval_summaries({name: result}, self.latest_global_step())
         result["best_ensemble"] = name
         result["global_step"] = self.latest_global_step()
@@ -1268,7 +1336,7 @@ class Estimator:
         names = iteration.candidate_names()
         accs = {n: WeightedMeanAccumulator() for n in names}
         for batch in self._eval_batches(data, steps):
-            size = batch_example_count(batch)
+            size = batch_metric_weight(batch, self._weight_key)
             results = iteration.eval_step(state, self._place_batch(batch))
             host = jax.device_get({n: results[n] for n in names})
             for n in names:
@@ -1290,6 +1358,11 @@ class Estimator:
 
         @jax.jit
         def predict_fn(params, features):
+            # Prediction features may carry the weight column (e.g. reusing
+            # the training input_fn); it never feeds the model.
+            features, _ = iteration_lib.split_example_weights(
+                features, self._weight_key, require=False
+            )
             ensemble = forward(params, features)
             return self._predictions_with_member_outputs(ensemble)
 
@@ -1333,6 +1406,9 @@ class Estimator:
             )
 
             def predict_fn(features):
+                features, _ = iteration_lib.split_example_weights(
+                    features, self._weight_key, require=False
+                )
                 outs = frozen.member_outputs(features, training=False)
                 ensemble = ensembler.build_ensemble(
                     frozen.ensembler_params, outs

@@ -15,7 +15,7 @@ from typing import Callable, List, Optional
 import jax
 import numpy as np
 
-from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
+from adanet_tpu.utils import WeightedMeanAccumulator, batch_metric_weight
 
 
 class Objective(str, enum.Enum):
@@ -73,11 +73,18 @@ class Evaluator:
             return np.nanargmin
         return np.nanargmax
 
-    def evaluate(self, iteration, state, batch_transform=None) -> List[float]:
+    def evaluate(
+        self,
+        iteration,
+        state,
+        batch_transform=None,
+        collective=False,
+    ) -> List[float]:
         """Mean metric per candidate, in `iteration.candidate_names()` order.
 
-        Per-batch means are weighted by example count so a ragged final
-        batch does not skew candidate scores (the reference streams
+        Per-batch means are weighted by example count — or, under
+        `weight_key`, by total example weight — so a ragged final batch
+        does not skew candidate scores (the reference streams
         example-weighted means, reference: adanet/core/evaluator.py:97-140).
 
         Args:
@@ -86,13 +93,21 @@ class Evaluator:
             training, where this evaluation is a collective program every
             process must run in lockstep — input_fns must then yield the
             same number of identically-shaped local batches per process).
+          collective: True when running in multi-host lockstep: cross-batch
+            weight sums are then allgathered so every process accumulates
+            identical candidate scores (a divergent ranking would freeze
+            different architectures per process).
         """
         names = iteration.candidate_names()
         acc = WeightedMeanAccumulator()
         for batch in self._input_fn():
             if self._steps is not None and acc.batches >= self._steps:
                 break
-            n = batch_example_count(batch)
+            n = batch_metric_weight(
+                batch,
+                getattr(iteration, "weight_key", None),
+                collective=collective,
+            )
             if batch_transform is not None:
                 batch = batch_transform(batch)
             results = iteration.eval_step(state, batch)

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import flax
 import jax
@@ -119,6 +119,39 @@ def _complexity_regularization(ensemble):
     return getattr(ensemble, "complexity_regularization", 0.0)
 
 
+def split_example_weights(features, weight_key, require=True):
+    """Splits per-example weights out of a features mapping.
+
+    The analogue of the reference's `weight_column` on canned heads
+    (reference: adanet/core/ensemble_builder.py:571-583, where
+    `head.create_estimator_spec` extracts the weight column from features):
+    when `weight_key` is set, `features` must be a mapping containing that
+    key; the returned features have the key removed (weights never feed the
+    model) and the weights ride alongside into every head loss/metric call.
+
+    Returns `(model_features, weights)`; `weights` is None when
+    `weight_key` is None. With `require=False` a missing key is tolerated
+    (serving-time features carry no weights).
+    """
+    if weight_key is None:
+        return features, None
+    if not isinstance(features, Mapping) or weight_key not in features:
+        if not require:
+            return features, None
+        raise ValueError(
+            "weight_key=%r is set but the features batch %s; pass "
+            "features as a dict holding the per-example weight column."
+            % (
+                weight_key,
+                "is not a mapping"
+                if not isinstance(features, Mapping)
+                else "with keys %s does not contain it" % sorted(features),
+            )
+        )
+    model_features = {k: v for k, v in features.items() if k != weight_key}
+    return model_features, features[weight_key]
+
+
 @struct.dataclass
 class TrainLossContext:
     """Teacher signals available to `Builder.build_subnetwork_loss`.
@@ -148,6 +181,7 @@ class Iteration:
         previous_ensemble: Optional[FrozenEnsemble] = None,
         collect_summaries: bool = True,
         compile_cache=None,
+        weight_key: Optional[str] = None,
     ):
         if not ensemble_specs:
             raise ValueError("An iteration needs at least one ensemble spec.")
@@ -156,6 +190,9 @@ class Iteration:
         self.ensemble_specs = list(ensemble_specs)
         self.frozen_subnetworks = list(frozen_subnetworks)
         self.head = head
+        # weight_column analogue: per-example weights extracted from the
+        # features mapping under this key feed every head loss/metric.
+        self.weight_key = weight_key
         self.adanet_loss_decay = float(adanet_loss_decay)
         # When False, builder summary hooks are traced out of the jitted
         # step entirely (no wasted device compute when nothing is written).
@@ -179,6 +216,9 @@ class Iteration:
     def init_state(self, rng, sample_batch) -> IterationState:
         """Initializes every candidate's parameters and optimizer state."""
         features, _ = sample_batch
+        features, _ = split_example_weights(
+            features, self.weight_key, require=False
+        )
         sub_states = {}
         sub_shapes = {}
         for spec in self.subnetwork_specs:
@@ -405,7 +445,12 @@ class Iteration:
         finite-guard quarantine. When the builder overrides
         `build_subnetwork_loss`, that custom loss trains the subnetwork
         (knowledge distillation, auxiliary heads, label smoothing, ...).
+
+        `features` may still carry the `weight_key` column; it is split out
+        here (once per trace) so every caller — the fused step and the
+        RoundRobin executors — gets identical weighting semantics.
         """
+        features, weights = split_example_weights(features, self.weight_key)
 
         def loss_fn(p):
             variables = {**st.variables, "params": p}
@@ -416,7 +461,7 @@ class Iteration:
                 out, labels, self.head, loss_context
             )
             if loss is None:
-                loss = self.head.loss(out.logits, labels)
+                loss = self.head.loss(out.logits, labels, weights)
             return loss, (out, mutated)
 
         (loss, (out, mutated)), grads = jax.value_and_grad(
@@ -439,7 +484,9 @@ class Iteration:
         )
         return new_st, out, loss
 
-    def ensemble_update(self, espec, est, cstate, member_outs, labels):
+    def ensemble_update(
+        self, espec, est, cstate, member_outs, labels, weights=None
+    ):
         """One ensemble candidate's mixture-weight update (inside jit).
 
         Gradients are stopped at member outputs, the scoping analogue of
@@ -449,7 +496,7 @@ class Iteration:
 
         def ensemble_loss(p):
             ens = espec.ensembler.build_ensemble(p, member_outs)
-            loss = self.head.loss(ens.logits, labels)
+            loss = self.head.loss(ens.logits, labels, weights)
             return loss + _complexity_regularization(ens), loss
 
         if espec.tx is None:
@@ -497,13 +544,18 @@ class Iteration:
 
     def _train_step_impl(self, state: IterationState, batch, extra_batches):
         features, labels = batch
+        # weight_key split: models see the stripped features, heads see the
+        # weights (reference weight_column, ensemble_builder.py:571-583).
+        model_features, weights = split_example_weights(
+            features, self.weight_key
+        )
         rng, step_rng = jax.random.split(state.rng)
         metrics: Dict[str, Any] = {}
 
         # 0) Forward the frozen members once, shared by all candidates (the
         #    reference also builds each subnetwork once per graph), and
         #    derive the distillation teacher signals.
-        frozen_outs = self.frozen_outputs(state.frozen, features)
+        frozen_outs = self.frozen_outputs(state.frozen, model_features)
 
         def make_loss_context(batch_features, shared_frozen_outs=None):
             if not self.frozen_subnetworks or self.previous_ensemble is None:
@@ -518,7 +570,7 @@ class Iteration:
                 state.ensembles[prev_name].params, outs
             )
 
-        loss_context = make_loss_context(features, frozen_outs)
+        loss_context = make_loss_context(model_features, frozen_outs)
 
         # 1) Train every new subnetwork on its own head loss (the analogue of
         #    builder.build_subnetwork_train_op; reference:
@@ -533,11 +585,14 @@ class Iteration:
             )
             # Bagged specs (own batch) get teacher signals recomputed on
             # their own features so distillation pairs matching examples.
-            spec_context = (
-                make_loss_context(own_features)
-                if spec.name in extra_batches
-                else loss_context
-            )
+            if spec.name in extra_batches:
+                own_model, _ = split_example_weights(
+                    own_features, self.weight_key
+                )
+                spec_context = make_loss_context(own_model)
+            else:
+                own_model = model_features
+                spec_context = loss_context
             new_st, out, loss = self.subnetwork_update(
                 spec,
                 state.subnetworks[spec.name],
@@ -550,7 +605,7 @@ class Iteration:
             # was trained — the subnetwork's own (possibly bagged) batch.
             metrics.update(
                 self.builder_summary_metrics(
-                    spec, out, own_features, own_labels
+                    spec, out, own_model, own_labels
                 )
             )
             if spec.name in extra_batches:
@@ -558,7 +613,7 @@ class Iteration:
                 out, _ = self._apply_subnetwork(
                     spec,
                     new_st.variables,
-                    features,
+                    model_features,
                     True,
                     {"dropout": jax.random.fold_in(step_rng, 1000 + i)},
                 )
@@ -579,6 +634,7 @@ class Iteration:
                 state.candidates[espec.name],
                 member_outs,
                 labels,
+                weights,
             )
             new_ensembles[espec.name] = new_est
             new_candidates[espec.name] = new_cstate
@@ -603,6 +659,7 @@ class Iteration:
         return self._eval_step(state, features, labels)
 
     def _eval_step_impl(self, state: IterationState, features, labels):
+        features, weights = split_example_weights(features, self.weight_key)
         sub_outs = {
             spec.name: spec.module.apply(
                 state.subnetworks[spec.name].variables,
@@ -618,16 +675,18 @@ class Iteration:
             ens = espec.ensembler.build_ensemble(
                 state.ensembles[espec.name].params, member_outs
             )
-            loss = self.head.loss(ens.logits, labels)
+            loss = self.head.loss(ens.logits, labels, weights)
             out = {
                 "loss": loss,
                 "adanet_loss": loss + _complexity_regularization(ens),
             }
-            out.update(self.head.eval_metrics(ens.logits, labels))
+            out.update(self.head.eval_metrics(ens.logits, labels, weights))
             results[espec.name] = out
         for spec in self.subnetwork_specs:
             results["subnetwork/%s" % spec.name] = {
-                "loss": self.head.loss(sub_outs[spec.name].logits, labels)
+                "loss": self.head.loss(
+                    sub_outs[spec.name].logits, labels, weights
+                )
             }
         return results
 
@@ -681,6 +740,10 @@ class Iteration:
     ):
         """Forward pass of one candidate ensemble (for predict/export)."""
         espec = self._spec_by_name[spec_name]
+        # Serving-time features may or may not carry the weight column.
+        features, _ = split_example_weights(
+            features, self.weight_key, require=False
+        )
         sub_outs = {
             s.name: s.module.apply(
                 state.subnetworks[s.name].variables, features, training=False
@@ -705,6 +768,9 @@ class Iteration:
         """
         espec = self._spec_by_name[spec_name]
         features, _ = sample_batch
+        features, _ = split_example_weights(
+            features, self.weight_key, require=False
+        )
         params = jax.device_get(state.ensembles[espec.name].params)
         weights = None
         if isinstance(params, dict):
@@ -782,6 +848,7 @@ class IterationBuilder:
         adanet_loss_decay: float = 0.9,
         collect_summaries: bool = True,
         compile_cache=None,
+        weight_key: Optional[str] = None,
     ):
         if not ensemblers:
             raise ValueError("At least one ensembler is required.")
@@ -793,6 +860,7 @@ class IterationBuilder:
         self._adanet_loss_decay = float(adanet_loss_decay)
         self._collect_summaries = bool(collect_summaries)
         self._compile_cache = compile_cache
+        self._weight_key = weight_key
 
     def _ensembler_by_name(self, name: str):
         for ensembler in self._ensemblers:
@@ -923,4 +991,5 @@ class IterationBuilder:
             collect_summaries=self._collect_summaries,
             compile_cache=self._compile_cache,
             previous_ensemble=previous_ensemble,
+            weight_key=self._weight_key,
         )

@@ -13,8 +13,13 @@ from typing import Callable, List, Optional, Sequence
 
 import jax
 
+from adanet_tpu.core.iteration import split_example_weights
 from adanet_tpu.subnetwork.report import MaterializedReport, Report
-from adanet_tpu.utils import WeightedMeanAccumulator, batch_example_count
+from adanet_tpu.utils import (
+    WeightedMeanAccumulator,
+    batch_example_count,
+    batch_metric_weight,
+)
 
 
 class ReportMaterializer:
@@ -44,6 +49,7 @@ class ReportMaterializer:
         state,
         included_subnetwork_names: Sequence[str],
         batch_transform=None,
+        collective=False,
     ) -> List[MaterializedReport]:
         """Computes every subnetwork's report metrics over the dataset."""
         reports = {}
@@ -53,6 +59,9 @@ class ReportMaterializer:
 
         # One jitted pass computes every report metric for every subnetwork.
         def batch_metrics(st, features, labels):
+            features, weights = split_example_weights(
+                features, getattr(iteration, "weight_key", None)
+            )
             out = {}
             for spec in iteration.subnetwork_specs:
                 subnetwork = spec.module.apply(
@@ -65,25 +74,38 @@ class ReportMaterializer:
                     for name, fn in reports[spec.name].metrics.items()
                 }
                 metrics["loss"] = iteration.head.loss(
-                    subnetwork.logits, labels
+                    subnetwork.logits, labels, weights
                 )
                 out[spec.name] = metrics
             return out
 
         jitted = jax.jit(batch_metrics)
         # Example-weighted means, so a ragged final batch is not
-        # over-weighted (ADVICE round 1).
+        # over-weighted (ADVICE round 1). Two accumulators per subnetwork:
+        # user metric fns receive no weights (their per-batch values are
+        # plain means → combined by example count), while the head loss is
+        # a weighted mean → combined by total example weight.
         accs = {name: WeightedMeanAccumulator() for name in reports}
+        loss_accs = {name: WeightedMeanAccumulator() for name in reports}
         count = 0
+        weight_key = getattr(iteration, "weight_key", None)
         for features, labels in self._input_fn():
             if self._steps is not None and count >= self._steps:
                 break
-            n = batch_example_count((features, labels))
+            batch = (features, labels)
+            n_examples = batch_example_count(batch)
+            n_weight = batch_metric_weight(
+                batch, weight_key, collective=collective
+            )
             if batch_transform is not None:
-                features, labels = batch_transform((features, labels))
+                features, labels = batch_transform(batch)
             host = jax.device_get(jitted(state, features, labels))
             for name, metrics in host.items():
-                accs[name].add(metrics, n)
+                loss_accs[name].add({"loss": metrics["loss"]}, n_weight)
+                accs[name].add(
+                    {k: v for k, v in metrics.items() if k != "loss"},
+                    n_examples,
+                )
             count += 1
         if count == 0:
             raise ValueError("Report input_fn yielded no batches.")
@@ -97,7 +119,10 @@ class ReportMaterializer:
                     name=spec.name,
                     hparams=dict(report.hparams),
                     attributes=dict(report.attributes),
-                    metrics=accs[spec.name].means(),
+                    metrics={
+                        **accs[spec.name].means(),
+                        **loss_accs[spec.name].means(),
+                    },
                     included_in_final_ensemble=(
                         spec.name in set(included_subnetwork_names)
                     ),

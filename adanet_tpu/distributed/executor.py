@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from adanet_tpu.core.compile_cache import CachedStep
+from adanet_tpu.core import iteration as iteration_lib
 from adanet_tpu.core.iteration import Iteration, IterationState
 from adanet_tpu.distributed import mesh as mesh_lib
 from adanet_tpu.distributed.placement import RoundRobinStrategy
@@ -96,10 +97,16 @@ class RoundRobinExecutor:
         # and the lax.scan window so the two dispatch modes cannot
         # diverge. `context_args` is () or (frozen_params, prev_params).
         def step_body(spec, st, features, labels, key, context_args):
+            # Model-visible features (weight_key stripped) for teacher
+            # forwards and summary hooks; subnetwork_update re-splits the
+            # raw features itself so weighting stays defined in one place.
+            model_features, _ = iteration_lib.split_example_weights(
+                features, iteration.weight_key
+            )
             if context_args:
                 frozen_params, prev_params = context_args
                 frozen_outs = iteration.frozen_outputs(
-                    frozen_params, features
+                    frozen_params, model_features
                 )
                 context = iteration.build_loss_context(
                     prev_params, frozen_outs
@@ -110,7 +117,7 @@ class RoundRobinExecutor:
                 spec, st, features, labels, key, loss_context=context
             )
             return new_st, loss, iteration.builder_summary_metrics(
-                spec, out, features, labels
+                spec, out, model_features, labels
             )
 
         # Per-spec programs route through the shared compile cache: a
@@ -195,6 +202,9 @@ class RoundRobinExecutor:
         # Ensemble-group jitted step: member forwards (no grads) + every
         # ensemble candidate's mixture-weight update on the ensemble submesh.
         def ens_step(ensembles, candidates, frozen, member_vars, features, labels):
+            features, weights = iteration_lib.split_example_weights(
+                features, iteration.weight_key
+            )
             sub_outs = {
                 spec.name: spec.module.apply(
                     member_vars[spec.name], features, training=False
@@ -216,6 +226,7 @@ class RoundRobinExecutor:
                         candidates[espec.name],
                         member_outs,
                         labels,
+                        weights,
                     )
                 )
                 new_ens[espec.name] = new_est
@@ -306,14 +317,22 @@ class RoundRobinExecutor:
 
     # ------------------------------------------------------------------ train
 
-    def train_step(self, state: IterationState, batch):
+    def train_step(self, state: IterationState, batch, extra_batches=None):
         """One candidate-parallel step. Returns (state, metrics).
 
         Dispatch order: all subnetwork steps first (async, disjoint
         submeshes run concurrently), then the ensemble group's step using
         member parameters synced every `sync_every` steps.
+
+        `extra_batches` optionally maps subnetwork names to dedicated
+        (features, labels) batches (bagging; reference:
+        adanet/autoensemble/common.py:59-93): the owning group trains on
+        its own batch, while the ensemble group's member forwards keep
+        using the shared batch — the placement analogue of the fused
+        path's shared-batch recompute.
         """
         features, labels = batch
+        extra_batches = extra_batches or {}
         rng, step_rng = jax.random.split(state.rng)
 
         new_subnetworks = {}
@@ -321,7 +340,7 @@ class RoundRobinExecutor:
         for i, spec in enumerate(self.iteration.subnetwork_specs):
             sub_mesh = self._sub_meshes[spec.name]
             sub_batch = mesh_lib.shard_batch(
-                (features, labels), sub_mesh
+                extra_batches.get(spec.name, (features, labels)), sub_mesh
             )
             rng_i = jax.random.fold_in(step_rng, i)
             if self._needs_context[spec.name]:

@@ -397,11 +397,19 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
 
     # ---------------------------------------------------------------- train
 
-    def train_step(self, state: IterationState, batch):
+    def train_step(self, state: IterationState, batch, extra_batches=None):
         """One candidate-parallel step; `batch` is this process's LOCAL
         batch. Owning processes dispatch their groups' programs; the
-        ensemble group additionally runs every mixture-weight update."""
+        ensemble group additionally runs every mixture-weight update.
+
+        `extra_batches` maps subnetwork names to dedicated LOCAL batches
+        (bagging): a group's effective bagged batch is the concatenation of
+        its owning processes' local bagged batches, exactly like the shared
+        batch — every process runs the candidate's own input pipeline, the
+        reference's per-worker-input-fn semantics
+        (adanet/autoensemble/common.py:59-93)."""
         features, labels = batch
+        extra_batches = extra_batches or {}
         rng, step_rng = jax.random.split(state.rng)
 
         new_subnetworks = dict(state.subnetworks)
@@ -411,7 +419,9 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             g = 1 + i
             if not self._owns(g):
                 continue
-            sub_batch = self._group_batch((features, labels), g)
+            sub_batch = self._group_batch(
+                extra_batches.get(spec.name, (features, labels)), g
+            )
             rng_i = jax.random.fold_in(step_rng, i)
             if self._needs_context[spec.name]:
                 new_st, loss, extra = self._sub_steps[spec.name](

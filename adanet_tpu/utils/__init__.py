@@ -3,6 +3,7 @@
 from adanet_tpu.utils.batches import (
     WeightedMeanAccumulator,
     batch_example_count,
+    batch_metric_weight,
 )
 from adanet_tpu.utils.trees import tree_finite
 from adanet_tpu.utils.trees import tree_where
@@ -11,6 +12,7 @@ from adanet_tpu.utils.trees import tree_zeros_like
 __all__ = [
     "WeightedMeanAccumulator",
     "batch_example_count",
+    "batch_metric_weight",
     "tree_finite",
     "tree_where",
     "tree_zeros_like",

@@ -8,6 +8,46 @@ import jax
 import numpy as np
 
 
+def batch_metric_weight(batch, weight_key=None, collective=False) -> float:
+    """Aggregation weight of one batch for cross-batch metric averaging.
+
+    Without a `weight_key` this is the example count. With one, per-batch
+    metric means are already weighted means over sum(batch weights)
+    (`heads._weighted_mean`), so combining batches by example count would
+    over-weight lightly-weighted batches: the correct cross-batch weight
+    is the batch's total example weight (matching the reference's
+    streamed `tf.metrics.mean(values, weights)` semantics).
+
+    `collective=True` marks a multi-host lockstep loop where `batch` is
+    the process-LOCAL shard of a global batch whose metrics are GLOBAL
+    means: the weight is then allgathered so every process accumulates
+    with the same (global) weight sums — otherwise processes could rank
+    candidates differently and freeze divergent architectures. Example
+    counts need no gather: local counts are the same fixed fraction of
+    the global count on every process.
+    """
+    if weight_key is not None:
+        features = batch[0] if isinstance(batch, tuple) else batch
+        try:
+            weights = features[weight_key]
+        except (TypeError, KeyError, IndexError):
+            weights = None
+        if weights is not None:
+            total = float(np.sum(np.asarray(weights)))
+            if collective and jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                total = float(
+                    np.sum(
+                        multihost_utils.process_allgather(
+                            np.asarray(total, np.float32)
+                        )
+                    )
+                )
+            return total
+    return float(batch_example_count(batch))
+
+
 def batch_example_count(batch) -> int:
     """Number of examples in a (features, labels) batch.
 
@@ -45,13 +85,14 @@ class WeightedMeanAccumulator:
     def batches(self) -> int:
         return self._batches
 
-    def add(self, metrics: Dict[str, float], example_count: int) -> None:
-        """Accumulates one batch's metric means, weighted by its size."""
+    def add(self, metrics: Dict[str, float], example_count: float) -> None:
+        """Accumulates one batch's metric means, weighted by its size (or
+        its total example weight under `weight_key`, which is fractional)."""
         for key, value in metrics.items():
             self._totals[key] = (
                 self._totals.get(key, 0.0) + float(value) * example_count
             )
-        self._examples += int(example_count)
+        self._examples += float(example_count)
         self._batches += 1
 
     def means(self) -> Dict[str, float]:

@@ -1,0 +1,150 @@
+"""Multi-host SPMD AutoEnsemble bagging runner (2 JAX processes).
+
+Spawned by `test_distributed.py::test_spmd_autoensemble_bagging`: the two
+processes train an `AutoEnsembleEstimator` whose pool has one candidate
+with a dedicated `train_input_fn` (bagging; reference:
+adanet/autoensemble/common.py:59-93). Each process feeds its LOCAL half of
+BOTH streams — the shared batch and the bagged candidate's batch — and the
+engine assembles per-candidate global batches over the process-spanning
+mesh. Each process writes `probe_<pid>.npz` with the frozen winner's
+member params so the test can assert cross-process identity and an oracle
+match against a single-process run on the concatenated streams.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def shared_batches():
+    """Deterministic shared global batches (16 rows each)."""
+    rng = np.random.RandomState(11)
+    batches = []
+    for _ in range(4):
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x @ np.ones((4, 1), np.float32)) + 0.1
+        batches.append(({"x": x}, y))
+    return batches
+
+
+def bagged_batches():
+    """The bagged candidate's own global stream (a different resample)."""
+    rng = np.random.RandomState(23)
+    batches = []
+    for _ in range(4):
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x @ np.full((4, 1), 0.5, np.float32)) - 0.2
+        batches.append(({"x": x}, y))
+    return batches
+
+
+def build_estimator(model_dir, bagged_fn):
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu import AutoEnsembleSubestimator
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class _Linear(nn.Module):
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            x = features["x"] if isinstance(features, dict) else features
+            return nn.Dense(1)(jnp.asarray(x, jnp.float32))
+
+    class _MLP(nn.Module):
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            x = features["x"] if isinstance(features, dict) else features
+            x = nn.relu(nn.Dense(8)(jnp.asarray(x, jnp.float32)))
+            return nn.Dense(1)(x)
+
+    return adanet_tpu.AutoEnsembleEstimator(
+        head=adanet_tpu.RegressionHead(),
+        candidate_pool={
+            "bagged": AutoEnsembleSubestimator(
+                _MLP(),
+                optimizer=optax.sgd(0.05),
+                train_input_fn=bagged_fn,
+            ),
+            "plain": AutoEnsembleSubestimator(
+                _Linear(), optimizer=optax.sgd(0.05)
+            ),
+        },
+        max_iteration_steps=6,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        max_iterations=1,
+        model_dir=model_dir,
+        log_every_steps=0,
+    )
+
+
+def main():
+    model_dir, process_id, port = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(
+        coordinator_address="localhost:%s" % port,
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+
+    lo, hi = (0, 8) if process_id == 0 else (8, 16)
+
+    def local(batches):
+        def input_fn():
+            for features, labels in batches():
+                yield {"x": features["x"][lo:hi]}, labels[lo:hi]
+
+        return input_fn
+
+    probes = {}
+
+    def capture(state):
+        # Both candidates' trained params (the frozen winner would only
+        # expose one): replicated arrays may span non-addressable devices,
+        # so fetch this process's local replica.
+        def fetch(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return np.asarray(x.addressable_shards[0].data)
+            return np.asarray(jax.device_get(x))
+
+        for name, st in state.subnetworks.items():
+            flat, _ = jax.tree_util.tree_flatten(
+                jax.tree_util.tree_map(fetch, st.variables["params"])
+            )
+            for i, leaf in enumerate(flat):
+                probes["%s_leaf%d" % (name, i)] = np.asarray(leaf)
+
+    base = build_estimator(model_dir, local(bagged_batches))
+
+    class ProbeEstimator(type(base)):
+        def _complete_iteration(self, iteration, state, *args, **kwargs):
+            capture(state)
+            return super()._complete_iteration(
+                iteration, state, *args, **kwargs
+            )
+
+    # Probe hook without duplicating the constructor arguments.
+    base.__class__ = ProbeEstimator
+    base.train(local(shared_batches), max_steps=6)
+    assert base.latest_iteration_number() == 1
+    assert probes, "no probes captured"
+
+    np.savez(
+        os.path.join(model_dir, "probe_%d.npz" % process_id), **probes
+    )
+    print("BAGGING ROLE %d DONE" % process_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,15 @@ def iteration_state_filename(global_step: int) -> str:
     return "ckpt-%d.msgpack" % global_step
 
 
+def final_state_filename(iteration_number: int) -> str:
+    """Retained end-of-iteration candidate state (all candidates, not just
+    the frozen winner), enabling per-candidate evaluation after the
+    iteration completes — the analogue of the reference's per-candidate
+    eval dirs surviving every bookkeeping phase
+    (reference: adanet/core/estimator.py:1683-1723)."""
+    return "iteration-final-%d.msgpack" % iteration_number
+
+
 def architecture_filename(iteration_number: int) -> str:
     """Reference layout: `<model_dir>/architecture-<t>.json`
     (reference: adanet/core/estimator.py:1725-1747)."""

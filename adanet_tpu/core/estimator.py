@@ -123,6 +123,12 @@ class Estimator:
         ensemble_builder.py:571-583). The column is stripped before models
         see the features; weights feed every head loss and eval metric —
         training, Evaluator candidate scoring, and `evaluate`.
+      keep_candidate_states: persist every candidate's final state when an
+        iteration completes (`iteration-final-<t>.msgpack`, one per
+        iteration), so `evaluate_all_candidates` keeps working after the
+        winner is frozen — the reference retains per-candidate eval dirs
+        across bookkeeping phases (estimator.py:1683-1723). Off by
+        default: it stores all candidates' parameters per iteration.
       log_every_steps: training-log period.
     """
 
@@ -156,6 +162,7 @@ class Estimator:
         export_subnetwork_logits: bool = False,
         export_subnetwork_last_layer: bool = False,
         weight_key: Optional[str] = None,
+        keep_candidate_states: bool = False,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -222,6 +229,7 @@ class Estimator:
         self._export_subnetwork_last_layer = bool(
             export_subnetwork_last_layer
         )
+        self._keep_candidate_states = bool(keep_candidate_states)
         # Training placement: a RoundRobinStrategy trains candidates on
         # disjoint submeshes; bookkeeping/evaluate/export always run
         # replicated, exactly as the reference forces ReplicationStrategy
@@ -1094,6 +1102,14 @@ class Estimator:
         frozen.architecture.add_replay_index(best_index)
         frozen.architecture.set_global_step(info.global_step)
 
+        if write and self._keep_candidate_states:
+            # Retain ALL candidates' final state (not just the winner) so
+            # per-candidate comparison survives iteration completion
+            # (reference: adanet/core/estimator.py:1683-1723).
+            ckpt_lib.save_pytree(
+                self._model_dir, ckpt_lib.final_state_filename(t), state
+            )
+
         if write:
             with open(
                 os.path.join(
@@ -1320,18 +1336,43 @@ class Estimator:
         The analogue of the reference's per-candidate eval event dirs
         (reference: adanet/core/estimator.py:1683-1723): every candidate
         ensemble's metrics are computed in one pass and written to
-        `<model_dir>/ensemble/<name>/eval`. Requires a checkpoint with
-        live (mid-iteration) candidate state.
+        `<model_dir>/ensemble/<name>/eval`. Uses the live mid-iteration
+        state when one exists; after an iteration completes, falls back to
+        the retained end-of-iteration state written under
+        `keep_candidate_states=True`.
         """
         info = ckpt_lib.read_manifest(self._model_dir)
-        if info is None or not info.iteration_state_file:
+        if info is None:
             raise ValueError(
-                "evaluate_all_candidates needs a mid-iteration checkpoint; "
-                "after an iteration completes only the winner remains."
+                "No checkpoint in %s; call train() first." % self._model_dir
             )
         first, data = self._bootstrap_input(input_fn)
-        iteration = self._build_iteration(info.iteration_number, first)
-        state = self._init_or_restore_state(iteration, first, info)
+        if info.iteration_state_file:
+            iteration = self._build_iteration(info.iteration_number, first)
+            state = self._init_or_restore_state(iteration, first, info)
+        else:
+            # Completed iteration: restore the retained candidate states
+            # of the last finished iteration.
+            t = info.iteration_number - 1
+            retained = ckpt_lib.final_state_filename(t)
+            if t < 0 or not os.path.exists(
+                os.path.join(self._model_dir, retained)
+            ):
+                raise ValueError(
+                    "evaluate_all_candidates after iteration completion "
+                    "needs retained candidate states; construct the "
+                    "Estimator with keep_candidate_states=True (or call "
+                    "during an iteration, from a mid-iteration checkpoint)."
+                )
+            iteration = self._build_iteration(t, first)
+            state = iteration.init_state(self._iteration_rng(t), first)
+            state = ckpt_lib.restore_pytree(
+                self._model_dir, retained, state
+            )
+            if self._spmd_mesh is not None:
+                # Mirror _init_or_restore_state's placement so eval_step
+                # composes with the globally-placed batches.
+                state = replicate_state(state, self._spmd_mesh)
 
         names = iteration.candidate_names()
         accs = {n: WeightedMeanAccumulator() for n in names}

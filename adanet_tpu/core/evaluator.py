@@ -98,11 +98,19 @@ class Evaluator:
             identical candidate scores (a divergent ranking would freeze
             different architectures per process).
         """
+        from adanet_tpu.distributed import mesh as mesh_lib
+
         names = iteration.candidate_names()
         acc = WeightedMeanAccumulator()
-        for batch in self._input_fn():
-            if self._steps is not None and acc.batches >= self._steps:
-                break
+        # The guarded stream agrees on every pull (including end-of-stream)
+        # across processes BEFORE entering a collective: a per-process
+        # mismatch raises on every process instead of deadlocking in XLA.
+        for batch in mesh_lib.lockstep_batches(
+            self._input_fn,
+            steps=self._steps,
+            collective=collective,
+            context="Evaluator",
+        ):
             n = batch_metric_weight(
                 batch,
                 getattr(iteration, "weight_key", None),

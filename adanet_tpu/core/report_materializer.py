@@ -87,12 +87,17 @@ class ReportMaterializer:
         # a weighted mean → combined by total example weight.
         accs = {name: WeightedMeanAccumulator() for name in reports}
         loss_accs = {name: WeightedMeanAccumulator() for name in reports}
+        from adanet_tpu.distributed import mesh as mesh_lib
+
         count = 0
         weight_key = getattr(iteration, "weight_key", None)
-        for features, labels in self._input_fn():
-            if self._steps is not None and count >= self._steps:
-                break
-            batch = (features, labels)
+        for batch in mesh_lib.lockstep_batches(
+            self._input_fn,
+            steps=self._steps,
+            collective=collective,
+            context="ReportMaterializer",
+        ):
+            features, labels = batch
             n_examples = batch_example_count(batch)
             n_weight = batch_metric_weight(
                 batch, weight_key, collective=collective

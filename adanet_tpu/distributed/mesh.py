@@ -153,3 +153,83 @@ def global_batch(batch, mesh: Mesh, stacked: bool = False):
         return jax.make_array_from_process_local_data(sharding, arr)
 
     return jax.tree_util.tree_map(put, batch)
+
+
+def batch_signature(batch) -> str:
+    """Structural signature of a batch: treedef + per-leaf dtype/shape."""
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        dtype = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if dtype is None or shape is None:
+            arr = np.asarray(leaf)
+            dtype, shape = arr.dtype, arr.shape
+        parts.append("%s:%s" % (dtype, tuple(shape)))
+    return "|".join(parts)
+
+
+def check_collective_lockstep(batch, context: str = "collective") -> None:
+    """Fails fast when multi-host lockstep streams diverge.
+
+    Collective bookkeeping (Evaluator, ReportMaterializer) requires every
+    process's input_fn to yield the same number of identically-shaped
+    batches; a mismatch would strand some processes inside an XLA
+    collective — a silent deadlock. Before each collective dispatch every
+    process allgathers a digest of its next batch (`None` = end of
+    stream); disagreement raises an actionable error ON EVERY process
+    instead (the reference's cooperative-failure philosophy, SURVEY §5.3).
+
+    One host DCN round-trip per batch — bookkeeping-only cadence, never
+    inside the training step path.
+    """
+    if jax.process_count() <= 1:
+        return
+    import hashlib
+
+    from jax.experimental import multihost_utils
+
+    sig = "<end-of-stream>" if batch is None else batch_signature(batch)
+    digest = np.frombuffer(
+        hashlib.sha256(sig.encode()).digest()[:8], dtype=np.uint64
+    )[0]
+    gathered = multihost_utils.process_allgather(np.asarray(digest))
+    if not bool(np.all(gathered == gathered[0])):
+        raise ValueError(
+            "%s: per-process input streams diverged — this process's next "
+            "batch is %s, but other processes disagree (digests %s). Every "
+            "process must yield the same number of identically-shaped "
+            "batches for collective bookkeeping; a mismatch would deadlock "
+            "in a collective. Check that eval/report input_fns are "
+            "deterministic and yield identical stream structure per "
+            "process." % (context, sig, [hex(int(g)) for g in gathered])
+        )
+
+
+def lockstep_batches(
+    input_fn,
+    steps: Optional[int] = None,
+    collective: bool = False,
+    context: str = "collective",
+):
+    """Yields up to `steps` batches from `input_fn`, agreeing on every
+    pull (including end-of-stream) across processes when `collective`.
+
+    The one shared stream-driving loop for collective bookkeeping
+    consumers (Evaluator, ReportMaterializer), so the guard cadence
+    cannot diverge between them. The `steps` cutoff is identical on every
+    process, so it broadcasts `<end-of-stream>` uniformly.
+    """
+    stream = iter(input_fn())
+    count = 0
+    while True:
+        batch = next(stream, None)
+        done = batch is None or (steps is not None and count >= steps)
+        if collective:
+            check_collective_lockstep(
+                None if done else batch, context=context
+            )
+        if done:
+            return
+        yield batch
+        count += 1

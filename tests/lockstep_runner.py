@@ -1,0 +1,85 @@
+"""Lockstep-guard runner: 2 JAX processes with diverging eval streams.
+
+Spawned by `test_distributed.py::test_collective_lockstep_guard`: the two
+processes run a collective Evaluator pass whose per-process input streams
+deliberately diverge (`count` mode: one process yields an extra batch;
+`shape` mode: one batch differs in size; `ok` mode: identical streams).
+The guard (`mesh.check_collective_lockstep`) must raise an actionable
+ValueError on BOTH processes instead of deadlocking inside an XLA
+collective — the reference's cooperative-failure philosophy (SURVEY §5.3).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    mode, process_id, port = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(
+        coordinator_address="localhost:%s" % port,
+        num_processes=2,
+        process_id=process_id,
+    )
+
+    import adanet_tpu
+    from adanet_tpu.core.evaluator import Evaluator
+    from adanet_tpu.core.iteration import IterationBuilder
+    from adanet_tpu.distributed import mesh as mesh_lib
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.ensemble.strategy import GrowStrategy
+
+    from helpers import DNNBuilder
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, 3).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+
+    def make_batch(n):
+        return {"x": x[:n]}, y[:n]
+
+    def input_fn():
+        yield make_batch(8)
+        if mode == "shape" and process_id == 1:
+            yield make_batch(4)
+        else:
+            yield make_batch(8)
+        if mode == "count" and process_id == 0:
+            yield make_batch(8)
+
+    iteration = IterationBuilder(
+        adanet_tpu.RegressionHead(),
+        [ComplexityRegularizedEnsembler()],
+        [GrowStrategy()],
+    ).build_iteration(0, [DNNBuilder("d", 1)])
+    state = iteration.init_state(jax.random.PRNGKey(0), make_batch(8))
+    mesh = mesh_lib.data_parallel_mesh()
+    state = jax.tree_util.tree_map(
+        lambda v: jax.device_put(v, mesh_lib.replicated(mesh)), state
+    )
+
+    evaluator = Evaluator(input_fn=input_fn)
+    try:
+        scores = evaluator.evaluate(
+            iteration,
+            state,
+            batch_transform=lambda b: mesh_lib.global_batch(b, mesh),
+            collective=True,
+        )
+    except ValueError as e:
+        assert "diverged" in str(e), str(e)
+        assert mode in ("count", "shape"), (mode, str(e))
+        print("LOCKSTEP %s ROLE %d RAISED" % (mode, process_id))
+        return
+    assert mode == "ok", "guard failed to fire in mode %r" % mode
+    assert np.isfinite(scores).all(), scores
+    print("LOCKSTEP %s ROLE %d OK" % (mode, process_id))
+
+
+if __name__ == "__main__":
+    main()

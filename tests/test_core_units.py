@@ -319,3 +319,56 @@ def test_evaluate_all_candidates(tmp_path):
     }
     for metrics in results.values():
         assert np.isfinite(metrics["adanet_loss"])
+
+
+def test_evaluate_all_candidates_after_completion(tmp_path):
+    """With keep_candidate_states=True the per-candidate comparison
+    survives iteration completion (reference retains per-candidate eval
+    dirs, estimator.py:1683-1723); without it, the error is actionable."""
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder, linear_dataset
+
+    def make(name, **kwargs):
+        return adanet_tpu.Estimator(
+            head=adanet_tpu.RegressionHead(),
+            subnetwork_generator=SimpleGenerator(
+                [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+            ),
+            max_iteration_steps=8,
+            ensemblers=[
+                ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+            ],
+            max_iterations=2,
+            model_dir=str(tmp_path / name),
+            log_every_steps=0,
+            **kwargs,
+        )
+
+    est = make("kept", keep_candidate_states=True)
+    est.train(linear_dataset(), max_steps=100)
+    assert est.latest_iteration_number() == 2
+
+    # Iteration-1 candidates: carried-over previous + grown ones.
+    results = est.evaluate_all_candidates(linear_dataset(), steps=2)
+    assert len(results) >= 2
+    assert any(name.startswith("t1_") for name in results)
+    for metrics in results.values():
+        assert np.isfinite(metrics["adanet_loss"])
+
+    # A fresh Estimator over the same model_dir can do it too (rebuild
+    # from disk, no in-process cache).
+    est2 = make("kept", keep_candidate_states=True)
+    results2 = est2.evaluate_all_candidates(linear_dataset(), steps=2)
+    assert {
+        n: round(m["adanet_loss"], 6) for n, m in results.items()
+    } == {n: round(m["adanet_loss"], 6) for n, m in results2.items()}
+
+    plain = make("plain")
+    plain.train(linear_dataset(), max_steps=100)
+    with pytest.raises(ValueError, match="keep_candidate_states"):
+        plain.evaluate_all_candidates(linear_dataset(), steps=2)

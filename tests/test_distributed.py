@@ -380,6 +380,52 @@ def test_spmd_autoensemble_bagging(tmp_path):
         )
 
 
+@pytest.mark.parametrize("mode", ["ok", "count", "shape"])
+def test_collective_lockstep_guard(tmp_path, mode):
+    """Mismatched per-process eval streams raise an actionable error on
+    EVERY process instead of deadlocking in an XLA collective
+    (mesh.check_collective_lockstep; cooperative failure, SURVEY §5.3)."""
+    import socket
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(__file__), "lockstep_runner.py")
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        port = sock.getsockname()[1]
+
+    def spawn(index):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        tests_dir = os.path.dirname(__file__)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [
+                os.path.dirname(tests_dir),
+                tests_dir,
+                env.get("PYTHONPATH", ""),
+            ]
+        )
+        return subprocess.Popen(
+            [sys.executable, runner, mode, str(index), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    procs = [spawn(0), spawn(1)]
+    outs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        outs.append(out)
+        assert proc.returncode == 0, out.decode()[-3000:]
+    expected = b"OK" if mode == "ok" else b"RAISED"
+    for i, out in enumerate(outs):
+        assert (
+            b"LOCKSTEP %s ROLE %d %s" % (mode.encode(), i, expected) in out
+        ), out.decode()[-3000:]
+
+
 def test_graft_dryrun_self_provisions_virtual_mesh():
     """The driver calls ``dryrun_multichip(8)`` on a host with one real
     chip; the entrypoint must provision its own virtual CPU mesh instead

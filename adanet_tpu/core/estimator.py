@@ -50,6 +50,7 @@ from adanet_tpu.core.report_accessor import ReportAccessor
 from adanet_tpu.core.report_materializer import ReportMaterializer
 from adanet_tpu.core.summary import ScopedSummary
 from adanet_tpu.distributed import coordination
+from adanet_tpu.distributed import mesh as mesh_lib
 from adanet_tpu.distributed.executor import RoundRobinExecutor
 from adanet_tpu.distributed.mesh import (
     data_parallel_mesh,
@@ -1223,15 +1224,22 @@ class Estimator:
         return first, itertools.chain([first], data)
 
     def _eval_batches(self, data, steps):
-        """Yields up to `steps` batches, debug-checked like training ones."""
-        count = 0
-        for batch in data:
-            if steps is not None and count >= steps:
-                break
+        """Yields up to `steps` batches, debug-checked like training ones.
+
+        Routed through the lockstep guard (a no-op unless an SPMD mesh is
+        live): the public eval paths are process-local after train()
+        returns, but any collective caller gets the same
+        cooperative-failure behavior as the Evaluator."""
+        guarded = mesh_lib.lockstep_batches(
+            lambda: data,
+            steps=steps,
+            collective=self._spmd_mesh is not None,
+            context="Estimator eval",
+        )
+        for batch in guarded:
             if self._debug:
                 self._check_batch_finite(batch)
             yield batch
-            count += 1
 
     def _write_eval_summaries(self, per_scope, global_step):
         """Per-candidate eval event dirs, the reference's
@@ -1296,7 +1304,11 @@ class Estimator:
         custom_acc = WeightedMeanAccumulator()
         for features, labels in self._eval_batches(data, steps):
             batch = (features, labels)
-            n = batch_metric_weight(batch, self._weight_key)
+            n = batch_metric_weight(
+                batch,
+                self._weight_key,
+                collective=self._spmd_mesh is not None,
+            )
             n_examples = batch_example_count(batch)
             features, labels = self._place_batch(batch)
             host, host_custom = jax.device_get(
@@ -1330,16 +1342,17 @@ class Estimator:
         self,
         input_fn: Callable[[], Iterator],
         steps: Optional[int] = None,
+        iteration_number: Optional[int] = None,
     ) -> Dict[str, Dict[str, float]]:
-        """Per-candidate metrics over a dataset (current iteration).
+        """Per-candidate metrics over a dataset.
 
         The analogue of the reference's per-candidate eval event dirs
         (reference: adanet/core/estimator.py:1683-1723): every candidate
         ensemble's metrics are computed in one pass and written to
         `<model_dir>/ensemble/<name>/eval`. Uses the live mid-iteration
-        state when one exists; after an iteration completes, falls back to
-        the retained end-of-iteration state written under
-        `keep_candidate_states=True`.
+        state when one exists; completed iterations use the retained
+        end-of-iteration states written under `keep_candidate_states=True`
+        (`iteration_number` selects which one; default the latest).
         """
         info = ckpt_lib.read_manifest(self._model_dir)
         if info is None:
@@ -1347,37 +1360,44 @@ class Estimator:
                 "No checkpoint in %s; call train() first." % self._model_dir
             )
         first, data = self._bootstrap_input(input_fn)
-        if info.iteration_state_file:
+        if info.iteration_state_file and iteration_number is None:
             iteration = self._build_iteration(info.iteration_number, first)
             state = self._init_or_restore_state(iteration, first, info)
         else:
-            # Completed iteration: restore the retained candidate states
-            # of the last finished iteration.
-            t = info.iteration_number - 1
+            # Completed iteration: restore that iteration's retained
+            # candidate states (every iteration's file stays reachable).
+            t = (
+                info.iteration_number - 1
+                if iteration_number is None
+                else int(iteration_number)
+            )
             retained = ckpt_lib.final_state_filename(t)
             if t < 0 or not os.path.exists(
                 os.path.join(self._model_dir, retained)
             ):
                 raise ValueError(
-                    "evaluate_all_candidates after iteration completion "
-                    "needs retained candidate states; construct the "
-                    "Estimator with keep_candidate_states=True (or call "
-                    "during an iteration, from a mid-iteration checkpoint)."
+                    "evaluate_all_candidates needs retained candidate "
+                    "states for iteration %d; construct the Estimator with "
+                    "keep_candidate_states=True (or call during an "
+                    "iteration, from a mid-iteration checkpoint)." % t
                 )
             iteration = self._build_iteration(t, first)
-            state = iteration.init_state(self._iteration_rng(t), first)
-            state = ckpt_lib.restore_pytree(
-                self._model_dir, retained, state
+            state = self._init_or_restore_state(
+                iteration,
+                first,
+                ckpt_lib.CheckpointInfo(
+                    iteration_number=t, iteration_state_file=retained
+                ),
             )
-            if self._spmd_mesh is not None:
-                # Mirror _init_or_restore_state's placement so eval_step
-                # composes with the globally-placed batches.
-                state = replicate_state(state, self._spmd_mesh)
 
         names = iteration.candidate_names()
         accs = {n: WeightedMeanAccumulator() for n in names}
         for batch in self._eval_batches(data, steps):
-            size = batch_metric_weight(batch, self._weight_key)
+            size = batch_metric_weight(
+                batch,
+                self._weight_key,
+                collective=self._spmd_mesh is not None,
+            )
             results = iteration.eval_step(state, self._place_batch(batch))
             host = jax.device_get({n: results[n] for n in names})
             for n in names:

@@ -1,0 +1,152 @@
+"""Generate the markdown API reference from the package's docstrings.
+
+The analogue of the reference's Sphinx `docs/source/adanet.*.rst` tree
+(reference: docs/source/adanet.rst etc. rendered on RTD): instead of a
+Sphinx build (not installable here), a dependency-free introspection pass
+walks the public surface of each documented module and emits one markdown
+file per module under `docs/api/`, preserving the docstrings' reference
+`file:line` citations so parity stays auditable from the rendered docs.
+
+Run from the repo root:  python docs/generate_api_reference.py
+CI keeps the output in sync via tests/test_docs.py.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import re
+import sys
+
+# Modules documented, mirroring the reference's docs/source/adanet.*.rst
+# set plus the subsystems this framework adds.
+API_MODULES = [
+    "adanet_tpu",
+    "adanet_tpu.core.estimator",
+    "adanet_tpu.core.evaluator",
+    "adanet_tpu.core.heads",
+    "adanet_tpu.core.iteration",
+    "adanet_tpu.core.report_materializer",
+    "adanet_tpu.core.summary",
+    "adanet_tpu.core.tpu_estimator",
+    "adanet_tpu.subnetwork",
+    "adanet_tpu.ensemble",
+    "adanet_tpu.autoensemble",
+    "adanet_tpu.distributed",
+    "adanet_tpu.replay",
+    "adanet_tpu.experimental",
+    "adanet_tpu.models",
+    "adanet_tpu.parallel",
+    "adanet_tpu.ops",
+    "adanet_tpu.utils",
+]
+
+
+def _public_members(module):
+    names = getattr(module, "__all__", None)
+    if names is None:
+        names = [n for n in vars(module) if not n.startswith("_")]
+    members = []
+    for name in names:
+        obj = getattr(module, name, None)
+        if obj is None or inspect.ismodule(obj):
+            continue
+        # Skip re-exports that belong to foreign packages (optax etc.).
+        owner = getattr(obj, "__module__", "") or ""
+        if not owner.startswith("adanet_tpu") and not owner.startswith(
+            "research"
+        ):
+            continue
+        members.append((name, obj))
+    return members
+
+
+def _signature(obj) -> str:
+    try:
+        sig = str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+    # Default values repr'd with memory addresses (sentinel objects) are
+    # not stable across runs; strip them so the output is reproducible.
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
+
+
+def _doc(obj) -> str:
+    doc = inspect.getdoc(obj)
+    return doc.strip() if doc else "*Undocumented.*"
+
+
+def _method_entries(cls):
+    entries = []
+    for name, member in sorted(vars(cls).items()):
+        if name.startswith("_") and name != "__call__":
+            continue
+        if isinstance(member, property):
+            entries.append(("property %s" % name, _doc(member)))
+        elif inspect.isfunction(member):
+            entries.append(
+                ("%s%s" % (name, _signature(member)), _doc(member))
+            )
+    return entries
+
+
+def render_module(module_name: str) -> str:
+    module = importlib.import_module(module_name)
+    lines = ["# `%s`" % module_name, ""]
+    if module.__doc__:
+        lines += [inspect.cleandoc(module.__doc__), ""]
+    for name, obj in _public_members(module):
+        if inspect.isclass(obj):
+            lines += [
+                "## class `%s%s`" % (name, _signature(obj)),
+                "",
+                _doc(obj),
+                "",
+            ]
+            for title, doc in _method_entries(obj):
+                lines += ["### `%s.%s`" % (name, title), "", doc, ""]
+        elif callable(obj):
+            lines += [
+                "## `%s%s`" % (name, _signature(obj)),
+                "",
+                _doc(obj),
+                "",
+            ]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def generate(out_dir: str) -> dict:
+    os.makedirs(out_dir, exist_ok=True)
+    written = {}
+    for module_name in API_MODULES:
+        content = render_module(module_name)
+        filename = module_name.replace(".", "-") + ".md"
+        written[filename] = content
+        with open(os.path.join(out_dir, filename), "w") as f:
+            f.write(content)
+    index = ["# adanet_tpu API reference", ""]
+    index.append(
+        "Generated from docstrings by `docs/generate_api_reference.py` "
+        "(the Sphinx-tree analogue of the reference's "
+        "docs/source/adanet.*.rst). Docstrings carry `file:line` "
+        "citations into the reference implementation for parity checks."
+    )
+    index.append("")
+    for module_name in API_MODULES:
+        index.append(
+            "- [`%s`](%s)" % (module_name, module_name.replace(".", "-") + ".md")
+        )
+    content = "\n".join(index) + "\n"
+    written["index.md"] = content
+    with open(os.path.join(out_dir, "index.md"), "w") as f:
+        f.write(content)
+    return written
+
+
+if __name__ == "__main__":
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    out = os.path.join(repo, "docs", "api")
+    files = generate(out)
+    print("wrote %d files to %s" % (len(files), out))

@@ -368,6 +368,14 @@ def test_evaluate_all_candidates_after_completion(tmp_path):
         n: round(m["adanet_loss"], 6) for n, m in results.items()
     } == {n: round(m["adanet_loss"], 6) for n, m in results2.items()}
 
+    # Earlier iterations stay reachable via iteration_number.
+    it0 = est.evaluate_all_candidates(
+        linear_dataset(), steps=2, iteration_number=0
+    )
+    assert all(name.startswith("t0_") for name in it0)
+    for metrics in it0.values():
+        assert np.isfinite(metrics["adanet_loss"])
+
     plain = make("plain")
     plain.train(linear_dataset(), max_steps=100)
     with pytest.raises(ValueError, match="keep_candidate_states"):

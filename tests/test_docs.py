@@ -1,0 +1,26 @@
+"""API reference stays in sync with the docstrings (docs/api/*.md)."""
+
+import os
+import sys
+
+
+def test_api_reference_in_sync(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "docs"))
+    import generate_api_reference as gen
+
+    fresh = gen.generate(str(tmp_path / "api"))
+    api_dir = os.path.join(repo, "docs", "api")
+    on_disk = {
+        name: open(os.path.join(api_dir, name)).read()
+        for name in os.listdir(api_dir)
+        if name.endswith(".md")
+    }
+    assert set(on_disk) == set(fresh), (
+        "docs/api file set is stale; run python docs/generate_api_reference.py"
+    )
+    stale = [name for name in fresh if fresh[name] != on_disk[name]]
+    assert not stale, (
+        "docs/api out of sync for %s; run "
+        "python docs/generate_api_reference.py" % stale
+    )

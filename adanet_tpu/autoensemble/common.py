@@ -41,6 +41,13 @@ class AutoEnsembleSubestimator:
         modules with richer outputs (reference `logits_fn`, common.py:31-40).
       last_layer_fn: optional fn mapping the module's output to the last
         hidden layer (reference `last_layer_fn`).
+      initial_variables: optional Flax variable collections ({"params":
+        ..., "batch_stats": ..., ...}) grafted over the module's random
+        init — how PRETRAINED modules enter the ensemble (the analogue of
+        the reference's TF-Hub modules arriving with trained weights,
+        customizing_adanet_with_tfhub.ipynb). Combine with
+        `prediction_only=True` for classic frozen transfer learning, or
+        leave trainable for fine-tuning.
     """
 
     module: Any
@@ -49,6 +56,7 @@ class AutoEnsembleSubestimator:
     prediction_only: bool = False
     logits_fn: Optional[Callable] = None
     last_layer_fn: Optional[Callable] = None
+    initial_variables: Optional[Any] = None
 
 
 def _make_wrapper_module(subestimator: AutoEnsembleSubestimator):
@@ -100,6 +108,19 @@ class _BuilderFromSubestimator(Builder):
     @property
     def prediction_only(self) -> bool:
         return self._subestimator.prediction_only
+
+    @property
+    def initial_variables(self):
+        """Pretrained variables re-nested under the wrapper's `inner`
+        submodule scope (how they appear in the built subnetwork's
+        tree); consulted by `Iteration.init_state`."""
+        user = self._subestimator.initial_variables
+        if user is None:
+            return None
+        return {
+            collection: {"inner": value}
+            for collection, value in user.items()
+        }
 
     def build_subnetwork(self, logits_dimension, previous_ensemble=None):
         del logits_dimension  # the user module owns its output width

@@ -228,6 +228,7 @@ class Iteration:
                 features,
                 training=True,
             )
+            variables = self._graft_initial_variables(spec, variables)
             opt_state = spec.tx.init(variables["params"])
             sub_states[spec.name] = SubnetworkTrainState(
                 variables=variables,
@@ -301,6 +302,47 @@ class Iteration:
             iteration_step=jnp.asarray(0, jnp.int32),
             rng=rng,
         )
+
+    @staticmethod
+    def _graft_initial_variables(spec, variables):
+        """Grafts builder-supplied pretrained variables over random init.
+
+        Builders exposing `initial_variables` (e.g. AutoEnsemble
+        subestimators carrying pretrained weights — the analogue of the
+        reference ensembling TF-Hub modules,
+        customizing_adanet_with_tfhub.ipynb) replace matching collections
+        wholesale; structure mismatches fail loudly here instead of as
+        opaque apply errors later.
+        """
+        initial = getattr(spec.builder, "initial_variables", None)
+        if not initial:
+            return variables
+        merged = dict(variables)
+        for collection, value in initial.items():
+            if collection not in merged:
+                raise ValueError(
+                    "initial_variables for builder %r carries collection "
+                    "%r, but the built module has only %s."
+                    % (spec.name, collection, sorted(merged))
+                )
+            value = jax.tree_util.tree_map(
+                jnp.asarray, flax.core.unfreeze(value)
+            )
+            exp_leaves, exp_def = jax.tree_util.tree_flatten(
+                flax.core.unfreeze(merged[collection])
+            )
+            got_leaves, got_def = jax.tree_util.tree_flatten(value)
+            if exp_def != got_def or [
+                tuple(l.shape) for l in exp_leaves
+            ] != [tuple(l.shape) for l in got_leaves]:
+                raise ValueError(
+                    "initial_variables[%r] for builder %r does not match "
+                    "the module's variable structure/shapes.\n"
+                    "Expected: %s\nGot: %s"
+                    % (collection, spec.name, exp_def, got_def)
+                )
+            merged[collection] = value
+        return merged
 
     def _warm_start_params(self, espec: EnsembleSpec):
         """Previous mixture weights aligned with this spec's members.

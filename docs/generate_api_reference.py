@@ -141,6 +141,11 @@ def generate(out_dir: str) -> dict:
     written["index.md"] = content
     with open(os.path.join(out_dir, "index.md"), "w") as f:
         f.write(content)
+    # Prune docs for removed/renamed modules, so re-running the generator
+    # actually fixes a stale file set.
+    for name in os.listdir(out_dir):
+        if name.endswith(".md") and name not in written:
+            os.remove(os.path.join(out_dir, name))
     return written
 
 

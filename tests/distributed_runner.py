@@ -30,9 +30,14 @@ from helpers import DNNBuilder, linear_dataset
 def main():
     model_dir = sys.argv[1]
     role_index = int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "train"
     from adanet_tpu.distributed import coordination
 
     coordination.set_process_index_for_testing(role_index)
+    # "timeout" mode: an abandoned worker (no chief ever completes the
+    # iteration) must surface WorkerWaitTimeout from train() itself, the
+    # reference's worker-countdown exit (estimator.py:951-984).
+    wait_secs = 3.0 if mode == "timeout" else 120.0
     estimator = adanet_tpu.Estimator(
         head=adanet_tpu.RegressionHead(),
         subnetwork_generator=SimpleGenerator(
@@ -45,8 +50,15 @@ def main():
         max_iterations=2,
         model_dir=model_dir,
         log_every_steps=0,
-        worker_wait_timeout_secs=120.0,
+        worker_wait_timeout_secs=wait_secs,
     )
+    if mode == "timeout":
+        try:
+            estimator.train(linear_dataset(), max_steps=100)
+        except coordination.WorkerWaitTimeout:
+            print("ROLE %d TIMED OUT CLEANLY" % role_index)
+            return
+        raise AssertionError("worker did not time out")
     estimator.train(linear_dataset(), max_steps=100)
     assert estimator.latest_iteration_number() == 2, (
         "expected 2 iterations, got %d"

@@ -34,6 +34,10 @@ def full_batches():
 
 def main():
     model_dir, process_id, port = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    world = int(sys.argv[4]) if len(sys.argv) > 4 else 2
+    assert 16 % world == 0, (
+        "world=%d must divide the 16-row global batches" % world
+    )
 
     import jax
 
@@ -41,11 +45,11 @@ def main():
     jax.config.update("jax_num_cpu_devices", 1)
     jax.distributed.initialize(
         coordinator_address="localhost:%s" % port,
-        num_processes=2,
+        num_processes=world,
         process_id=process_id,
     )
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 2, jax.devices()
+    assert jax.process_count() == world, jax.process_count()
+    assert len(jax.devices()) == world, jax.devices()
     assert len(jax.local_devices()) == 1, jax.local_devices()
 
     import optax
@@ -59,10 +63,11 @@ def main():
     from helpers import DNNBuilder
 
     def local_input_fn():
-        # This process's half of every global batch: rows [0:8] on the
-        # chief, [8:16] on the worker (the global row order of
-        # make_array_from_process_local_data over the 2-device mesh).
-        lo, hi = (0, 8) if process_id == 0 else (8, 16)
+        # This process's slice of every 16-row global batch (the global
+        # row order of make_array_from_process_local_data over the
+        # world-sized mesh): contiguous 16/world-row chunks per process.
+        rows = 16 // world
+        lo, hi = process_id * rows, (process_id + 1) * rows
         for features, labels in full_batches():
             yield {"x": features["x"][lo:hi]}, labels[lo:hi]
 

@@ -108,3 +108,43 @@ def test_search_converges_to_target_accuracy(tmp_path):
     )
     assert metrics["accuracy"] >= 0.94, metrics
     assert metrics["top_5_accuracy"] >= 0.99, metrics
+
+
+@pytest.mark.slow
+def test_nasnet_family_converges(tmp_path):
+    """Flagship-family gate (RUN_SLOW=1): a small NASNet-A candidate
+    search on the digit images must clear the linear plateau decisively
+    (reference accuracy contract: research/improve_nas/README.md:41)."""
+    from research.improve_nas.trainer.improve_nas import Builder, Hparams
+    from adanet_tpu.examples.synthetic_digits import image_input_fn
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    xtr, ytr = make_dataset(8192, seed=7)
+    xte, yte = make_dataset(2048, seed=8)
+    hparams = Hparams(
+        num_cells=3,
+        num_conv_filters=8,
+        use_aux_head=False,
+        drop_path_keep_prob=1.0,
+        dense_dropout_keep_prob=1.0,
+        clip_gradients=5.0,
+        weight_decay=1e-4,
+        initial_learning_rate=1e-3,
+    )
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.MultiClassHead(n_classes=10),
+        subnetwork_generator=SimpleGenerator(
+            [Builder(lambda lr: optax.adam(lr), hparams, seed=0)]
+        ),
+        max_iteration_steps=300,
+        max_iterations=1,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.adam(1e-3))
+        ],
+        model_dir=str(tmp_path / "model"),
+        log_every_steps=0,
+    )
+    est.train(image_input_fn(xtr, ytr), max_steps=10**6)
+    metrics = est.evaluate(image_input_fn(xte, yte))
+    assert metrics["accuracy"] >= 0.88, metrics
+    assert metrics["accuracy"] > LINEAR_BASELINE_ACCURACY

@@ -186,12 +186,40 @@ def test_multi_process_chief_worker(tmp_path):
     assert b"ROLE 1 DONE" in worker_out
 
 
-def test_multi_host_spmd_data_path(tmp_path):
-    """Two real `jax.distributed` processes train ONE SPMD program: each
-    feeds half of every global batch, gradients psum across processes,
-    and both end with identical params that match a single-process oracle
-    trained on the full batches (proof the collective aggregated both
-    halves; reference semantics: adanet/docs/source/distributed.md:6-27)."""
+def test_worker_timeout_inside_train(tmp_path):
+    """A worker whose chief never finishes the iteration times out INSIDE
+    a real train() call with WorkerWaitTimeout (not a bare
+    wait_for_iteration test; reference: estimator.py:951-984 exits the
+    worker on the countdown)."""
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(__file__), "distributed_runner.py")
+    model_dir = str(tmp_path / "abandoned_model")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    worker = subprocess.Popen(
+        [sys.executable, runner, model_dir, "1", "timeout"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    out, _ = worker.communicate(timeout=300)
+    assert worker.returncode == 0, out.decode()[-2000:]
+    assert b"ROLE 1 TIMED OUT CLEANLY" in out
+
+
+@pytest.mark.parametrize(
+    "world", [2, pytest.param(4, marks=pytest.mark.slow)]
+)
+def test_multi_host_spmd_data_path(tmp_path, world):
+    """`world` real `jax.distributed` processes train ONE SPMD program:
+    each feeds its slice of every global batch, gradients psum across
+    processes, and all end with identical params that match a
+    single-process oracle trained on the full batches (proof the
+    collective aggregated every slice; reference semantics:
+    adanet/docs/source/distributed.md:6-27)."""
     import socket
     import subprocess
     import sys
@@ -225,27 +253,36 @@ def test_multi_host_spmd_data_path(tmp_path):
             ]
         )
         return subprocess.Popen(
-            [sys.executable, runner, model_dir, str(index), str(port)],
+            [
+                sys.executable,
+                runner,
+                model_dir,
+                str(index),
+                str(port),
+                str(world),
+            ],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
         )
 
-    chief = spawn(0)
-    worker = spawn(1)
-    chief_out, _ = chief.communicate(timeout=600)
-    worker_out, _ = worker.communicate(timeout=600)
-    assert chief.returncode == 0, chief_out.decode()[-3000:]
-    assert worker.returncode == 0, worker_out.decode()[-3000:]
-    assert b"SPMD ROLE 0 DONE" in chief_out
-    assert b"SPMD ROLE 1 DONE" in worker_out
+    procs = [spawn(i) for i in range(world)]
+    for i, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=600)
+        assert proc.returncode == 0, (i, out.decode()[-3000:])
+        assert ("SPMD ROLE %d DONE" % i).encode() in out
 
-    # Both processes computed the collective result: identical params.
-    p0 = np.load(os.path.join(model_dir, "probe_0.npz"))
-    p1 = np.load(os.path.join(model_dir, "probe_1.npz"))
-    assert sorted(p0.files) == sorted(p1.files) and p0.files
-    for key in p0.files:
-        np.testing.assert_array_equal(p0[key], p1[key])
+    # Every process computed the collective result: identical params.
+    probes = [
+        np.load(os.path.join(model_dir, "probe_%d.npz" % i))
+        for i in range(world)
+    ]
+    p0 = probes[0]
+    assert p0.files
+    for other in probes[1:]:
+        assert sorted(other.files) == sorted(p0.files)
+        for key in p0.files:
+            np.testing.assert_array_equal(p0[key], other[key])
 
     # Single-process oracle on the concatenated batches: the SPMD run must
     # match it — only possible if gradients aggregated across processes.

@@ -28,6 +28,9 @@ from adanet_tpu.experimental.phases import (
     SequentialController,
     TrainerPhase,
     TrainerWorkUnit,
+    GreedyMutationTuner,
+    RandomSearchTuner,
+    Tuner,
     TunerPhase,
     WeightedEnsemble,
     WeightedEnsembler,
@@ -65,6 +68,9 @@ __all__ = [
     "Storage",
     "TrainerPhase",
     "TrainerWorkUnit",
+    "GreedyMutationTuner",
+    "RandomSearchTuner",
+    "Tuner",
     "TunerPhase",
     "WeightedEnsemble",
     "WeightedEnsembler",

@@ -11,7 +11,18 @@ from __future__ import annotations
 
 import abc
 import random
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+import threading
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
@@ -57,12 +68,16 @@ class TrainerWorkUnit(WorkUnit):
         eval_dataset: Callable[[], Iterable],
         storage: Storage,
         epochs: int = 1,
+        on_result: Optional[Callable[[List[float]], None]] = None,
     ):
         self._model = model
         self._train_dataset = train_dataset
         self._eval_dataset = eval_dataset
         self._storage = storage
         self._epochs = epochs
+        # Result hook for adaptive consumers (the TunerPhase feedback
+        # loop); called after the evaluation completes.
+        self._on_result = on_result
 
     def execute(self) -> None:
         if self._model.trainable:
@@ -71,6 +86,8 @@ class TrainerWorkUnit(WorkUnit):
         self._storage.save_model(
             ModelContainer(results[0], self._model, results)
         )
+        if self._on_result is not None:
+            self._on_result(list(results))
 
 
 # --------------------------------------------------------------------- phases
@@ -179,25 +196,164 @@ class TrainerPhase(DatasetProvider, ModelProvider):
         return self._storage.get_best_models(num_models)
 
 
-class TunerPhase(TrainerPhase):
-    """Random-search over a model-builder function: the stand-in for the
-    reference's KerasTuner integration
-    (reference: phases/keras_tuner_phase.py:29-71).
+class Tuner(abc.ABC):
+    """Trial-by-trial hyperparameter oracle.
 
-    `build_model(trial_rng) -> Model` is sampled `num_trials` times.
+    The analogue of the KerasTuner Oracle the reference's tuner phase
+    wraps (reference: phases/keras_tuner_phase.py:29-71): `create_trial`
+    proposes the next hyperparameters (None = search done) and
+    `report_trial` feeds the trial's score back, so later proposals can
+    depend on earlier results — adaptive search, not a pre-sampled list.
+    """
+
+    @abc.abstractmethod
+    def create_trial(self) -> Optional[Dict[str, Any]]:
+        """Next trial's hyperparameters, or None when the search is over."""
+
+    @abc.abstractmethod
+    def report_trial(self, hparams: Dict[str, Any], score: float) -> None:
+        """Feeds back a finished trial's score (lower is better)."""
+
+
+class RandomSearchTuner(Tuner):
+    """Uniform random search over a discrete space.
+
+    `space` maps each hyperparameter name to a sequence of choices (or a
+    zero-arg callable producing a value).
+    """
+
+    def __init__(self, space: Dict[str, Any], max_trials: int = 4, seed: int = 0):
+        if not space:
+            raise ValueError("space must be non-empty")
+        self._space = dict(space)
+        self._max_trials = int(max_trials)
+        self._rng = random.Random(seed)
+        self._trials: List[Tuple[Dict[str, Any], Optional[float]]] = []
+        # ParallelScheduler work units report concurrently; duplicate
+        # hparams must claim distinct trial slots. Reentrant: subclass
+        # create_trial consults best_trial() under the same lock.
+        self._lock = threading.RLock()
+
+    @property
+    def trials(self) -> List[Tuple[Dict[str, Any], Optional[float]]]:
+        """(hparams, score) per trial, in creation order."""
+        with self._lock:
+            return list(self._trials)
+
+    def _sample(self) -> Dict[str, Any]:
+        out = {}
+        for name, choices in self._space.items():
+            out[name] = (
+                choices() if callable(choices) else self._rng.choice(choices)
+            )
+        return out
+
+    def create_trial(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if len(self._trials) >= self._max_trials:
+                return None
+            hparams = self._sample()
+            self._trials.append((hparams, None))
+            return hparams
+
+    def report_trial(self, hparams: Dict[str, Any], score: float) -> None:
+        with self._lock:
+            # Earliest unscored slot with these hparams: duplicate trials
+            # each claim their own slot even under concurrent reports.
+            for i, (trial_hparams, trial_score) in enumerate(self._trials):
+                if trial_hparams == hparams and trial_score is None:
+                    self._trials[i] = (hparams, float(score))
+                    return
+
+    def best_trial(self) -> Optional[Tuple[Dict[str, Any], float]]:
+        with self._lock:
+            scored = [t for t in self._trials if t[1] is not None]
+        return min(scored, key=lambda t: t[1]) if scored else None
+
+
+class GreedyMutationTuner(RandomSearchTuner):
+    """Adaptive hill climbing: random warmup, then mutate the best trial.
+
+    After `warmup_trials` uniform samples, each new trial copies the
+    best-scoring hyperparameters so far and re-samples ONE dimension —
+    proposals genuinely depend on reported results (the adaptivity the
+    reference gets from KerasTuner oracles)."""
+
+    def __init__(
+        self,
+        space: Dict[str, Any],
+        max_trials: int = 8,
+        warmup_trials: int = 2,
+        seed: int = 0,
+    ):
+        super().__init__(space, max_trials=max_trials, seed=seed)
+        self._warmup = int(warmup_trials)
+
+    def create_trial(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if len(self._trials) >= self._max_trials:
+                return None
+            best = self.best_trial()
+            if len(self._trials) < self._warmup or best is None:
+                hparams = self._sample()
+            else:
+                hparams = dict(best[0])
+                name = self._rng.choice(sorted(self._space))
+                choices = self._space[name]
+                hparams[name] = (
+                    choices()
+                    if callable(choices)
+                    else self._rng.choice(choices)
+                )
+            self._trials.append((hparams, None))
+            return hparams
+
+
+class TunerPhase(TrainerPhase):
+    """Adaptive hyperparameter search over a model-builder function.
+
+    The analogue of the reference's KerasTuner phase
+    (reference: phases/keras_tuner_phase.py:29-71): the `tuner` proposes
+    hyperparameters trial by trial; each trial's model is built LAZILY,
+    trained/evaluated as a work unit, and its score reported back before
+    the next trial is proposed — so adaptive tuners steer the search and
+    memory holds one un-trained model at a time, not the whole trial
+    list.
+
+    Adaptivity requires a sequential scheduler (`InProcessScheduler`);
+    under `ParallelScheduler` trials overlap, so reports arrive late and
+    an adaptive tuner degrades toward its warmup behavior (random
+    search is unaffected).
     """
 
     def __init__(
         self,
-        build_model: Callable[[random.Random], Model],
-        num_trials: int = 4,
-        seed: int = 0,
+        build_model: Callable[[Dict[str, Any]], Model],
+        tuner: Tuner,
         epochs: int = 1,
         storage: Optional[Storage] = None,
     ):
-        rng = random.Random(seed)
-        models = [build_model(rng) for _ in range(num_trials)]
-        super().__init__(models, epochs=epochs, storage=storage)
+        super().__init__([], epochs=epochs, storage=storage)
+        self._build_model = build_model
+        self._tuner = tuner
+
+    def work_units(self, previous_phase):
+        self._train, self._eval = _datasets_from(previous_phase)
+        while True:
+            hparams = self._tuner.create_trial()
+            if hparams is None:
+                return
+            model = self._build_model(hparams)
+            yield TrainerWorkUnit(
+                model,
+                self._train,
+                self._eval,
+                self._storage,
+                self._epochs,
+                on_result=lambda results, hp=hparams: (
+                    self._tuner.report_trial(hp, results[0])
+                ),
+            )
 
 
 # ------------------------------------------------ ensemble phase + strategies

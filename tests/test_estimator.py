@@ -10,6 +10,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 import optax
 
 import adanet_tpu
@@ -596,3 +597,46 @@ def test_export_subnetwork_outputs_in_predict(tmp_path):
     assert "subnetwork_logits/1" in preds  # 2 members after 2 iterations
     assert preds["subnetwork_logits/0"].shape == (16, 1)
     assert preds["subnetwork_last_layer/0"].shape[0] == 16
+
+
+def test_evaluate_and_predict_from_mid_iteration_checkpoint(tmp_path):
+    """evaluate()/predict() work from a mid-iteration checkpoint: the
+    current best candidate serves (reference keeps serving mid-iteration
+    too, estimator.py:1055-1068 analogue)."""
+    est = _make_estimator(tmp_path, max_iterations=2)
+    # Stop mid-iteration-0: only live candidate state exists on disk.
+    est.train(linear_dataset(), max_steps=5)
+    assert est.latest_iteration_number() == 0
+    info_metrics = est.evaluate(linear_dataset())
+    assert np.isfinite(info_metrics["average_loss"])
+    assert info_metrics["best_ensemble"].startswith("t0_")
+    preds = list(est.predict(linear_dataset()))
+    assert len(preds) == 4 and preds[0]["predictions"].shape == (16, 1)
+
+    # A FRESH estimator over the same model_dir (no in-process cache)
+    # serves from the mid-iteration checkpoint too.
+    est2 = _make_estimator(tmp_path, max_iterations=2)
+    again = est2.evaluate(linear_dataset())
+    assert again["average_loss"] == pytest.approx(
+        info_metrics["average_loss"], rel=1e-6
+    )
+
+
+def test_nondeterministic_generator_rebuild_error(tmp_path):
+    """A generator that renames its builders between runs breaks the
+    deterministic rebuild chain with an actionable error (reference
+    requires deterministic generators for graph reconstruction,
+    estimator.py:1785-1882)."""
+    est = _make_estimator(tmp_path, max_iterations=1)
+    est.train(linear_dataset(), max_steps=100)
+    assert est.latest_iteration_number() == 1
+
+    renamed = _make_estimator(
+        tmp_path,
+        max_iterations=2,
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("renamed", 1), DNNBuilder("deep", 2)]
+        ),
+    )
+    with pytest.raises(ValueError, match="deterministic"):
+        renamed.train(linear_dataset(), max_steps=200)

@@ -103,19 +103,66 @@ def test_model_search_trainer_then_ensemble():
 
 
 def test_tuner_phase_random_search():
+    from adanet_tpu.experimental import RandomSearchTuner
+
+    built = []
+
+    def build_model(hparams):
+        built.append(dict(hparams))
+        return _model(hidden=hparams["hidden"], seed=hparams["seed"])
+
+    tuner = RandomSearchTuner(
+        space={"hidden": [4, 8, 16], "seed": [0, 1, 2, 3]},
+        max_trials=3,
+    )
     phases = [
         InputPhase(_dataset(0), _dataset(1)),
-        TunerPhase(
-            build_model=lambda rng: _model(
-                hidden=rng.choice([4, 8, 16]), seed=rng.randint(0, 100)
-            ),
-            num_trials=3,
-            epochs=2,
-        ),
+        TunerPhase(build_model=build_model, tuner=tuner, epochs=2),
     ]
     search = ModelSearch(SequentialController(phases))
     search.run()
     assert len(list(search.get_best_models(3))) == 3
+    assert len(built) == 3  # built lazily, once per trial
+    # Every trial got its score reported back.
+    assert all(score is not None for _, score in tuner.trials)
+    assert tuner.best_trial()[1] == min(s for _, s in tuner.trials)
+
+
+def test_tuner_phase_adaptive_mutation():
+    """GreedyMutationTuner proposals depend on reported results: after
+    the warmup, each trial mutates the best hyperparameters in exactly
+    one dimension (the reference's oracle-driven adaptivity,
+    keras_tuner_phase.py:29-71)."""
+    from adanet_tpu.experimental import GreedyMutationTuner
+
+    tuner = GreedyMutationTuner(
+        space={"hidden": [4, 8, 16], "lr": [0.1, 0.01]},
+        max_trials=6,
+        warmup_trials=2,
+        seed=3,
+    )
+    phases = [
+        InputPhase(_dataset(0), _dataset(1)),
+        TunerPhase(
+            build_model=lambda hp: _model(hidden=hp["hidden"], seed=0),
+            tuner=tuner,
+            epochs=1,
+        ),
+    ]
+    ModelSearch(SequentialController(phases)).run()
+    trials = tuner.trials
+    assert len(trials) == 6 and all(s is not None for _, s in trials)
+    # Post-warmup proposals differ from the best-so-far in <= 1 dimension.
+    for i in range(2, len(trials)):
+        best_before = min(
+            (t for t in trials[:i]), key=lambda t: t[1]
+        )[0]
+        diffs = sum(
+            1
+            for key in best_before
+            if trials[i][0][key] != best_before[key]
+        )
+        assert diffs <= 1
 
 
 def test_repeat_phase():

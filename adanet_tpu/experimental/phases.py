@@ -236,9 +236,10 @@ class RandomSearchTuner(Tuner):
 
     @property
     def trials(self) -> List[Tuple[Dict[str, Any], Optional[float]]]:
-        """(hparams, score) per trial, in creation order."""
+        """(hparams, score) per trial, in creation order (copies: the
+        tuner's history must not alias caller-visible dicts)."""
         with self._lock:
-            return list(self._trials)
+            return [(dict(h), s) for h, s in self._trials]
 
     def _sample(self) -> Dict[str, Any]:
         out = {}
@@ -253,7 +254,9 @@ class RandomSearchTuner(Tuner):
             if len(self._trials) >= self._max_trials:
                 return None
             hparams = self._sample()
-            self._trials.append((hparams, None))
+            # Store a private copy: user code (build_model) may mutate the
+            # returned dict, and the trial history is the search state.
+            self._trials.append((dict(hparams), None))
             return hparams
 
     def report_trial(self, hparams: Dict[str, Any], score: float) -> None:
@@ -268,7 +271,10 @@ class RandomSearchTuner(Tuner):
     def best_trial(self) -> Optional[Tuple[Dict[str, Any], float]]:
         with self._lock:
             scored = [t for t in self._trials if t[1] is not None]
-        return min(scored, key=lambda t: t[1]) if scored else None
+        if not scored:
+            return None
+        hparams, score = min(scored, key=lambda t: t[1])
+        return dict(hparams), score
 
 
 class GreedyMutationTuner(RandomSearchTuner):
@@ -300,12 +306,19 @@ class GreedyMutationTuner(RandomSearchTuner):
                 hparams = dict(best[0])
                 name = self._rng.choice(sorted(self._space))
                 choices = self._space[name]
-                hparams[name] = (
-                    choices()
-                    if callable(choices)
-                    else self._rng.choice(choices)
-                )
-            self._trials.append((hparams, None))
+                if callable(choices):
+                    hparams[name] = choices()
+                else:
+                    # A "mutation" that re-samples the incumbent value is
+                    # a wasted train/eval cycle; exclude it when other
+                    # choices exist.
+                    alternatives = [
+                        c for c in choices if c != hparams[name]
+                    ]
+                    hparams[name] = self._rng.choice(
+                        alternatives or list(choices)
+                    )
+            self._trials.append((dict(hparams), None))
             return hparams
 
 
@@ -343,6 +356,9 @@ class TunerPhase(TrainerPhase):
             hparams = self._tuner.create_trial()
             if hparams is None:
                 return
+            # Snapshot before user code runs: build_model may mutate its
+            # argument, and the report must match the proposed trial.
+            trial_key = dict(hparams)
             model = self._build_model(hparams)
             yield TrainerWorkUnit(
                 model,
@@ -350,7 +366,7 @@ class TunerPhase(TrainerPhase):
                 self._eval,
                 self._storage,
                 self._epochs,
-                on_result=lambda results, hp=hparams: (
+                on_result=lambda results, hp=trial_key: (
                     self._tuner.report_trial(hp, results[0])
                 ),
             )

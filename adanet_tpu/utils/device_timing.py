@@ -88,6 +88,12 @@ def time_steps_on_device(
         seconds, count = trace_device_seconds(trace_dir)
     finally:
         shutil.rmtree(trace_dir, ignore_errors=True)
+    if count == 0 or seconds <= 0.0:
+        raise ValueError(
+            "Trace recorded no device-lane executable events (e.g. CPU "
+            "backend traces have no XLA Modules device lane); use a host "
+            "clock instead."
+        )
     if expected_dispatches is not None and count != expected_dispatches:
         raise ValueError(
             "Profiled window recorded %d device dispatches, expected %d; "

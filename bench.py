@@ -9,14 +9,15 @@ program — on synthetic CIFAR-10-shaped data, for two configurations:
 - `cnn`: the round-1 two-candidate CNN config, kept for round-over-round
   comparability.
 
-Honest accounting (round-1 verdict):
+Honest accounting (round-1 verdict; tightened round 3):
 - FLOPs/step comes from XLA's own cost analysis of the compiled program
   (`compiled.cost_analysis()['flops']`), not a hand-waved estimate; MFU =
   achieved FLOPs/sec/chip over the chip's peak (bf16 peak table below).
-- Wall-clock through the axon TPU tunnel is NOT trustworthy (it has
-  reported physically impossible rates); when the axon plugin is detected
-  the JSON carries `timing_caveat` and MFU is still reported so the judge
-  can sanity-check the claim (MFU > 1 means the clock lied).
+- Timing uses the DEVICE's own clock: the profiler's "XLA Modules" lane
+  records on-device duration per dispatch (utils/device_timing.py,
+  validated against a peak-bound matmul chain at ~99% MFU). The axon
+  tunnel's host wall clock is untrustworthy (round-2 run showed MFU>1 on
+  the CNN config); it is reported only as `host_clock_*` side data.
 - `vs_baseline`: the reference publishes NO throughput numbers
   (BASELINE.md), so the denominator is a PINNED, NON-MEASURED estimate of
   P100 per-GPU throughput on the comparable CNN config — labeled as such
@@ -28,6 +29,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -50,8 +52,10 @@ PEAK_FLOPS_BY_DEVICE_KIND = {
     "TPU v6e": 918e12,
 }
 
-WARMUP_STEPS = 5
-MEASURE_STEPS = 20
+# Overridable so the CPU contract test (tests/test_bench.py) stays
+# bounded: NASNet steps take seconds each on CPU, milliseconds on TPU.
+WARMUP_STEPS = int(os.environ.get("ADANET_BENCH_WARMUP_STEPS", "5"))
+MEASURE_STEPS = int(os.environ.get("ADANET_BENCH_MEASURE_STEPS", "20"))
 
 
 def _peak_flops():
@@ -69,15 +73,53 @@ def _axon_tunnel() -> bool:
 IMAGE_SIZE = 32
 
 
-def _measure_iteration(builders, batch_size):
-    """Times `MEASURE_STEPS` fused train steps; returns throughput + MFU."""
+def _timed_loop(loop, state, expected_dispatches=None):
+    """Times `loop(state) -> state` (MEASURE_STEPS dispatches inside).
+
+    Primary clock is the DEVICE's own (profiler XLA Modules lane,
+    utils/device_timing.py); the host number comes from a separate
+    UNTRACED run so it carries no profiler overhead. Returns
+    (elapsed_seconds, clock, host_elapsed, dispatches): `elapsed_seconds`
+    is per-device busy seconds when clock=="device", else the untraced
+    host elapsed.
+    """
+    from adanet_tpu.utils.device_timing import time_steps_on_device
+
+    holder = {}
+
+    def traced():
+        holder["state"] = loop(state)
+
+    device_seconds = dispatches = None
+    clock = "host_fallback"
+    try:
+        total, dispatches = time_steps_on_device(
+            traced, expected_dispatches=expected_dispatches
+        )
+        # Each device records its own dispatches; summed busy time over
+        # concurrently-running chips maps back to per-device seconds.
+        device_seconds = total / jax.device_count()
+        clock = "device"
+    except Exception as exc:
+        sys.stderr.write(
+            "device-clock timing unavailable (%s: %s); reporting the "
+            "host clock\n" % (type(exc).__name__, exc)
+        )
+    # Untraced host-clock run: fresh timing, no tracing overhead. Reuses
+    # the traced run's final state when available (step inputs are
+    # donated, so the original buffers are gone after a completed run).
+    st = holder.get("state", state)
+    start = time.perf_counter()
+    loop(st)
+    host_elapsed = time.perf_counter() - start
+    elapsed = device_seconds if device_seconds else host_elapsed
+    return elapsed, clock, host_elapsed, dispatches
+
+
+def _build_bench_iteration(builders):
+    """The shared iteration-under-test (one ensembler, GrowStrategy)."""
     from adanet_tpu.core.heads import MultiClassHead
     from adanet_tpu.core.iteration import IterationBuilder
-    from adanet_tpu.distributed import (
-        data_parallel_mesh,
-        replicate_state,
-        shard_batch,
-    )
     from adanet_tpu.ensemble import (
         ComplexityRegularizedEnsembler,
         GrowStrategy,
@@ -93,7 +135,18 @@ def _measure_iteration(builders, batch_size):
         ensemble_strategies=[GrowStrategy()],
         collect_summaries=False,
     )
-    iteration = factory.build_iteration(0, builders, None)
+    return factory.build_iteration(0, builders, None)
+
+
+def _measure_iteration(builders, batch_size):
+    """Times `MEASURE_STEPS` fused train steps; returns throughput + MFU."""
+    from adanet_tpu.distributed import (
+        data_parallel_mesh,
+        replicate_state,
+        shard_batch,
+    )
+
+    iteration = _build_bench_iteration(builders)
 
     num_chips = jax.device_count()
     mesh = data_parallel_mesh()
@@ -130,11 +183,15 @@ def _measure_iteration(builders, batch_size):
         state, metrics = compiled(state, batch, {})
     jax.block_until_ready(metrics)
 
-    start = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = compiled(state, batch, {})
-    jax.block_until_ready(metrics)
-    elapsed = time.perf_counter() - start
+    def loop(st):
+        for _ in range(MEASURE_STEPS):
+            st, metrics = compiled(st, batch, {})
+        jax.block_until_ready(metrics)
+        return st
+
+    elapsed, clock, host_elapsed, _ = _timed_loop(
+        loop, state, expected_dispatches=MEASURE_STEPS * num_chips
+    )
 
     examples_per_sec_per_chip = (
         MEASURE_STEPS * global_batch / elapsed / num_chips
@@ -147,6 +204,10 @@ def _measure_iteration(builders, batch_size):
             if flops_per_device_step
             else None
         ),
+        "clock": clock,
+        "host_clock_examples_per_sec_per_chip": round(
+            MEASURE_STEPS * global_batch / host_elapsed / num_chips, 1
+        ),
     }
     peak = _peak_flops()
     if flops_per_device_step and peak:
@@ -158,7 +219,64 @@ def _measure_iteration(builders, batch_size):
     return out
 
 
+def _measure_round_robin(builders, batch_size):
+    """Times the RoundRobin executor path (per-submesh dispatch + member
+    transfers) on the same iteration workload — the differentiating
+    execution mode the fused numbers do not cover. On one chip all groups
+    share the device, so device-busy seconds is the honest denominator and
+    the delta vs the fused config is pure dispatch/transfer overhead."""
+    from adanet_tpu.distributed.executor import RoundRobinExecutor
+
+    executor = RoundRobinExecutor(_build_bench_iteration(builders))
+
+    rng = np.random.RandomState(0)
+    batch = (
+        {
+            "image": rng.randn(batch_size, IMAGE_SIZE, IMAGE_SIZE, 3).astype(
+                np.float32
+            )
+        },
+        rng.randint(0, 10, size=(batch_size,)),
+    )
+    state = executor.init_state(jax.random.PRNGKey(0), batch)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = executor.train_step(state, batch)
+    jax.block_until_ready((state, metrics))
+
+    def loop(st):
+        for _ in range(MEASURE_STEPS):
+            st, metrics = executor.train_step(st, batch)
+        jax.block_until_ready((st, metrics))
+        return st
+
+    # Multiple programs per step (N subnetworks + ensemble + transfers):
+    # no fixed dispatch count to assert.
+    elapsed, clock, _, dispatches = _timed_loop(loop, state)
+
+    return {
+        "examples_per_sec_per_chip": round(
+            MEASURE_STEPS * batch_size / elapsed / jax.device_count(), 1
+        ),
+        "device_dispatches_per_step": (
+            round(dispatches / MEASURE_STEPS, 1) if dispatches else None
+        ),
+        "clock": clock,
+    }
+
+
 def main():
+    # This environment preloads jax with the axon TPU plugin and pins the
+    # platform via jax.config, so the JAX_PLATFORMS env var alone is
+    # ignored (the tests/conftest.py lesson). Honor an explicit CPU
+    # request (the contract test) by updating the config before any
+    # backend initialization.
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+
     from adanet_tpu.examples.simple_cnn import CNNBuilder
     from research.improve_nas.trainer.improve_nas import Builder as NASBuilder
     from research.improve_nas.trainer.improve_nas import Hparams
@@ -178,6 +296,13 @@ def main():
         batch_size=128,
     )
     cnn = _measure_iteration(
+        [
+            CNNBuilder(num_blocks=2, channels=64),
+            CNNBuilder(num_blocks=3, channels=64),
+        ],
+        batch_size=256,
+    )
+    round_robin = _measure_round_robin(
         [
             CNNBuilder(num_blocks=2, channels=64),
             CNNBuilder(num_blocks=3, channels=64),
@@ -204,6 +329,7 @@ def main():
         ),
         "nasnet": nasnet,
         "cnn": cnn,
+        "round_robin_cnn": round_robin,
         "device_kind": jax.devices()[0].device_kind,
         "num_chips": jax.device_count(),
         "flops_model": "XLA compiled-program cost_analysis()",
@@ -211,9 +337,10 @@ def main():
     }
     if _axon_tunnel():
         result["timing_caveat"] = (
-            "wall-clock measured through the axon TPU tunnel is not "
-            "trustworthy (known to report impossible rates); treat "
-            "examples/sec and MFU as upper bounds, cross-check mfu <= 1"
+            "axon tunnel: the HOST clock is untrustworthy (r2 run showed "
+            "mfu>1); primary numbers use the device clock (profiler XLA "
+            "Modules lane, see utils/device_timing.py) when clock=device; "
+            "host_clock_* side data is for cross-checking only"
         )
     print(json.dumps(result))
 

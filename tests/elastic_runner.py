@@ -1,0 +1,113 @@
+"""Elastic resume runner: train multi-process, resume with a SHRUNK world.
+
+Spawned by `test_distributed.py::test_elastic_shrunk_world_resume`.
+Phase "a" runs a 2-process SPMD search and stops mid-iteration on a
+max_steps budget (the Estimator persists the mid-iteration state).
+Phase "b" resumes the SAME model_dir with a single process — the world
+shrank after a lost host — and runs the search to completion.
+
+This works because durable state is world-size-agnostic by design: the
+manifest + msgpack payloads are host pytrees (no sharding baked in), and
+`_init_or_restore_state` re-replicates them over whatever mesh the
+resuming world has (adanet_tpu/core/estimator.py:1010-1029). The
+reference's cooperative-recovery analogue is checkpoint-mediated restart
+at fixed cluster shape (reference: adanet/core/estimator.py:951-984,
+iteration.py:40-118); shrink-resume goes beyond it.
+
+Each process feeds its LOCAL shard of a fixed 16-row global batch, so the
+global data stream is identical across phases regardless of world size.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def local_batches(world: int, process_id: int):
+    """Deterministic 16-row global batches; this process's shard."""
+    rng = np.random.RandomState(7)
+    shard = 16 // world
+    lo, hi = process_id * shard, (process_id + 1) * shard
+    while True:
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x @ np.ones((4, 1), np.float32)) + 0.1
+        yield {"x": x[lo:hi]}, y[lo:hi]
+
+
+def main():
+    model_dir, phase, process_id, port, world = (
+        sys.argv[1],
+        sys.argv[2],
+        int(sys.argv[3]),
+        sys.argv[4],
+        int(sys.argv[5]),
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    if world > 1:
+        jax.distributed.initialize(
+            coordinator_address="localhost:%s" % port,
+            num_processes=world,
+            process_id=process_id,
+        )
+        assert jax.process_count() == world
+
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder
+
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [
+                DNNBuilder("d1", hidden=4, learning_rate=0.05),
+                DNNBuilder("d2", hidden=8, learning_rate=0.05),
+            ]
+        ),
+        max_iteration_steps=20,
+        max_iterations=2,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        model_dir=model_dir,
+        log_every_steps=0,
+        save_checkpoint_steps=5,
+    )
+
+    start_step = est.latest_global_step()
+    if phase == "a":
+        # Budget-limited: stops mid-iteration 0 and persists state.
+        est.train(
+            lambda: local_batches(world, process_id), max_steps=8
+        )
+        if process_id == 0:
+            with open(os.path.join(model_dir, "phase_a.json"), "w") as f:
+                json.dump({"global_step": est.latest_global_step()}, f)
+    else:
+        # Shrunk world: one process feeds the WHOLE global batch.
+        est.train(lambda: local_batches(world, process_id))
+        metrics = est.evaluate(
+            lambda: local_batches(world, process_id), steps=4
+        )
+        with open(os.path.join(model_dir, "phase_b.json"), "w") as f:
+            json.dump(
+                {
+                    "resume_start_step": start_step,
+                    "final_step": est.latest_global_step(),
+                    "final_iteration": est.latest_iteration_number(),
+                    "loss": float(metrics["loss"]),
+                },
+                f,
+            )
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,13 @@ class Estimator:
         winner is frozen — the reference retains per-candidate eval dirs
         across bookkeeping phases (estimator.py:1683-1723). Off by
         default: it stores all candidates' parameters per iteration.
+      prefetch_buffer: when > 0, training input iterators (the shared
+        stream and per-candidate bagging streams) are drained on a
+        background thread with this many batches buffered ahead — the
+        tf.data `.prefetch` analogue (the reference gets this from
+        tf.data's C++ runtime for free), overlapping host batch prep
+        with device steps. Ordering is preserved, so training is
+        unchanged bit-for-bit. 0 disables.
       log_every_steps: training-log period.
     """
 
@@ -164,6 +171,7 @@ class Estimator:
         export_subnetwork_last_layer: bool = False,
         weight_key: Optional[str] = None,
         keep_candidate_states: bool = False,
+        prefetch_buffer: int = 0,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -231,6 +239,10 @@ class Estimator:
             export_subnetwork_last_layer
         )
         self._keep_candidate_states = bool(keep_candidate_states)
+        if prefetch_buffer < 0:
+            raise ValueError("prefetch_buffer must be >= 0.")
+        self._prefetch_buffer = int(prefetch_buffer)
+        self._open_prefetchers: list = []
         # Training placement: a RoundRobinStrategy trains candidates on
         # disjoint submeshes; bookkeeping/evaluate/export always run
         # replicated, exactly as the reference forces ReplicationStrategy
@@ -390,6 +402,9 @@ class Estimator:
             # Leaving the mesh set would silently turn public eval calls
             # into collectives that hang unless every process joins.
             self._spmd_mesh = None
+            # Abandoned mid-stream prefetch workers would otherwise park
+            # on their queues until process exit.
+            self._close_prefetchers()
         return self
 
     def _should_stop(self) -> bool:
@@ -655,6 +670,13 @@ class Estimator:
                 jax.profiler.stop_trace()
                 profiling = False
 
+            # Per-candidate bagging iterators die with the iteration;
+            # close their prefetch workers now instead of letting parked
+            # daemon threads and pinned batch buffers accumulate across a
+            # long search (the shared data_iter lives on).
+            for it in extra_iters.values():
+                self._close_iter(it)
+
             if executor is not None:
                 # Bookkeeping (selection/eval/freeze) runs replicated, as
                 # the reference forces ReplicationStrategy outside training.
@@ -719,13 +741,40 @@ class Estimator:
                 )
                 cached_previous = None
 
+    def _make_train_iter(self, input_fn):
+        """Fresh iterator over input_fn(), prefetched when configured."""
+        data_iter = iter(input_fn())
+        if self._prefetch_buffer > 0:
+            from adanet_tpu.utils.prefetch import PrefetchIterator
+
+            data_iter = PrefetchIterator(
+                data_iter, buffer_size=self._prefetch_buffer
+            )
+            self._open_prefetchers.append(data_iter)
+        return data_iter
+
+    def _close_prefetchers(self) -> None:
+        for prefetcher in self._open_prefetchers:
+            prefetcher.close()
+        self._open_prefetchers.clear()
+
+    def _close_iter(self, data_iter) -> None:
+        """Closes a prefetched iterator (no-op for plain iterators)."""
+        close = getattr(data_iter, "close", None)
+        if close is not None:
+            close()
+        try:
+            self._open_prefetchers.remove(data_iter)
+        except ValueError:
+            pass
+
     def _next_batch(self, input_fn, data_iter):
         if data_iter is None:
-            data_iter = iter(input_fn())
+            data_iter = self._make_train_iter(input_fn)
         try:
             batch = next(data_iter)
         except StopIteration:
-            data_iter = iter(input_fn())
+            data_iter = self._make_train_iter(input_fn)
             try:
                 batch = next(data_iter)
             except StopIteration:

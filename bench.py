@@ -4,10 +4,17 @@ Measures one full AdaNet iteration step — every candidate's
 forward/backward plus the mixture-weight update, in one jitted XLA
 program — on synthetic CIFAR-10-shaped data, for two configurations:
 
-- `nasnet` (headline): one NASNet-A candidate (the BASELINE.md flagship
-  family, research/improve_nas) — 6 cells @ 32 filters.
+- `nasnet_windowed` (headline): one NASNet-A candidate (the BASELINE.md
+  flagship family, research/improve_nas; 6 cells @ 32 filters — i.e. the
+  paper's NASNet-A (6@768) CIFAR model) on the iterations_per_loop scan
+  path: one device dispatch for the whole measured window.
+- `nasnet`: the same workload with one dispatch per step (round-2
+  comparable; through the axon tunnel this path is dominated by
+  per-dispatch round-trips).
 - `cnn`: the round-1 two-candidate CNN config, kept for round-over-round
   comparability.
+- `round_robin_cnn`: the cnn config through the RoundRobin executor
+  (candidate-parallel placement) — measures dispatch/transfer overhead.
 
 Honest accounting (round-1 verdict; tightened round 3):
 - FLOPs/step comes from XLA's own cost analysis of the compiled program
@@ -53,9 +60,13 @@ PEAK_FLOPS_BY_DEVICE_KIND = {
 }
 
 # Overridable so the CPU contract test (tests/test_bench.py) stays
-# bounded: NASNet steps take seconds each on CPU, milliseconds on TPU.
+# bounded: NASNet steps take seconds each on CPU (and the XLA:CPU compile
+# of the full scan program takes >40 min), milliseconds on TPU. The
+# driver's TPU run uses the full defaults.
 WARMUP_STEPS = int(os.environ.get("ADANET_BENCH_WARMUP_STEPS", "5"))
 MEASURE_STEPS = int(os.environ.get("ADANET_BENCH_MEASURE_STEPS", "20"))
+NASNET_CELLS = int(os.environ.get("ADANET_BENCH_NASNET_CELLS", "6"))
+NASNET_FILTERS = int(os.environ.get("ADANET_BENCH_NASNET_FILTERS", "32"))
 
 
 def _peak_flops():
@@ -138,8 +149,20 @@ def _build_bench_iteration(builders):
     return factory.build_iteration(0, builders, None)
 
 
-def _measure_iteration(builders, batch_size):
-    """Times `MEASURE_STEPS` fused train steps; returns throughput + MFU."""
+def _measure_iteration(
+    builders, batch_size, windowed=False, flops_per_example=None
+):
+    """Times `MEASURE_STEPS` fused train steps; returns throughput + MFU.
+
+    With `windowed=True` all MEASURE_STEPS steps run inside ONE device
+    dispatch via `Iteration.train_steps`'s lax.scan — the
+    iterations_per_loop production path (core/tpu_estimator.py), which
+    amortizes the per-dispatch host/tunnel latency that dominates
+    per-step dispatch through the axon tunnel. XLA's cost_analysis counts
+    a scan body ONCE (not per trip), so the windowed config must take
+    `flops_per_example` from the per-step program's analysis (identical
+    math per step by construction).
+    """
     from adanet_tpu.distributed import (
         data_parallel_mesh,
         replicate_state,
@@ -152,51 +175,76 @@ def _measure_iteration(builders, batch_size):
     mesh = data_parallel_mesh()
     rng = np.random.RandomState(0)
     global_batch = batch_size * num_chips
+    batch_shape = (
+        (MEASURE_STEPS, global_batch) if windowed else (global_batch,)
+    )
     batch = (
         {
             "image": rng.randn(
-                global_batch, IMAGE_SIZE, IMAGE_SIZE, 3
+                *batch_shape, IMAGE_SIZE, IMAGE_SIZE, 3
             ).astype(np.float32)
         },
-        rng.randint(0, 10, size=(global_batch,)),
+        rng.randint(0, 10, size=batch_shape),
     )
-    batch = shard_batch(batch, mesh)
-    state = iteration.init_state(jax.random.PRNGKey(0), batch)
+    batch = shard_batch(batch, mesh, stacked=windowed)
+    sample = (
+        jax.tree_util.tree_map(lambda x: x[0], batch) if windowed else batch
+    )
+    state = iteration.init_state(jax.random.PRNGKey(0), sample)
     state = replicate_state(state, mesh)
 
     # Compile ONCE (AOT) and reuse the executable for both the cost
     # analysis and the timing loops. Under SPMD lowering with sharded
     # inputs, cost_analysis() describes the PER-DEVICE partitioned
-    # module, i.e. flops for global_batch/num_chips examples.
-    jitted = jax.jit(iteration._train_step_impl, donate_argnums=0)
-    compiled = jitted.lower(state, batch, {}).compile()
+    # module, i.e. flops for global_batch/num_chips examples (times
+    # MEASURE_STEPS scanned steps when windowed).
+    if windowed:
+        jitted = jax.jit(
+            iteration._train_multi_step_impl, donate_argnums=0
+        )
+        compiled = jitted.lower(state, batch).compile()
+        call = lambda st: compiled(st, batch)
+        dispatches_per_loop = 1
+        steps_per_dispatch = MEASURE_STEPS
+    else:
+        jitted = jax.jit(iteration._train_step_impl, donate_argnums=0)
+        compiled = jitted.lower(state, batch, {}).compile()
+        call = lambda st: compiled(st, batch, {})
+        dispatches_per_loop = MEASURE_STEPS
+        steps_per_dispatch = 1
+    per_device_batch = global_batch // num_chips
     flops_per_device_step = None
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0]
-        flops_per_device_step = float(analysis.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    if flops_per_example is not None:
+        flops_per_device_step = flops_per_example * per_device_batch
+    elif not windowed:
+        # Windowed programs get NO fallback analysis: cost_analysis counts
+        # the scan body once, so pricing from it would understate MFU by
+        # MEASURE_STEPS x. Without an override the windowed MFU stays None.
+        try:
+            analysis = compiled.cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0]
+            flops_per_device_step = float(analysis.get("flops", 0.0)) or None
+        except Exception:
+            pass
 
-    for _ in range(WARMUP_STEPS):
-        state, metrics = compiled(state, batch, {})
+    for _ in range(max(1, WARMUP_STEPS // steps_per_dispatch)):
+        state, metrics = call(state)
     jax.block_until_ready(metrics)
 
     def loop(st):
-        for _ in range(MEASURE_STEPS):
-            st, metrics = compiled(st, batch, {})
+        for _ in range(dispatches_per_loop):
+            st, metrics = call(st)
         jax.block_until_ready(metrics)
         return st
 
     elapsed, clock, host_elapsed, _ = _timed_loop(
-        loop, state, expected_dispatches=MEASURE_STEPS * num_chips
+        loop, state, expected_dispatches=dispatches_per_loop * num_chips
     )
 
     examples_per_sec_per_chip = (
         MEASURE_STEPS * global_batch / elapsed / num_chips
     )
-    per_device_batch = global_batch // num_chips
     out = {
         "examples_per_sec_per_chip": round(examples_per_sec_per_chip, 1),
         "flops_per_example": (
@@ -281,19 +329,30 @@ def main():
     from research.improve_nas.trainer.improve_nas import Builder as NASBuilder
     from research.improve_nas.trainer.improve_nas import Hparams
 
-    nasnet = _measure_iteration(
-        [
-            NASBuilder(
-                optimizer_fn=lambda lr: optax.sgd(lr, momentum=0.9),
-                hparams=Hparams(
-                    num_cells=6,
-                    num_conv_filters=32,
-                    use_aux_head=False,
-                ),
-                seed=0,
-            )
-        ],
+    def nasnet_builder():
+        return NASBuilder(
+            optimizer_fn=lambda lr: optax.sgd(lr, momentum=0.9),
+            hparams=Hparams(
+                num_cells=NASNET_CELLS,
+                num_conv_filters=NASNET_FILTERS,
+                use_aux_head=False,
+            ),
+            seed=0,
+        )
+
+    # Headline: the production dispatch path (iterations_per_loop scan —
+    # one device dispatch for all MEASURE_STEPS steps). Per-step dispatch
+    # is kept as side data; through the axon tunnel its wall clock is
+    # dominated by per-dispatch round-trips the scan path amortizes. The
+    # per-step run goes first so its cost_analysis FLOPs (which XLA
+    # reports correctly only for non-scanned programs) price the windowed
+    # MFU too.
+    nasnet = _measure_iteration([nasnet_builder()], batch_size=128)
+    nasnet_windowed = _measure_iteration(
+        [nasnet_builder()],
         batch_size=128,
+        windowed=True,
+        flops_per_example=nasnet["flops_per_example"],
     )
     cnn = _measure_iteration(
         [
@@ -311,9 +370,10 @@ def main():
     )
 
     result = {
-        # Headline: the flagship NASNet-A candidate iteration.
+        # Headline: the flagship NASNet-A candidate iteration on the
+        # windowed (iterations_per_loop) dispatch path.
         "metric": "nasnet_a_iteration_examples_per_sec_per_chip",
-        "value": nasnet["examples_per_sec_per_chip"],
+        "value": nasnet_windowed["examples_per_sec_per_chip"],
         "unit": "examples/sec/chip",
         # Ratio on the r1-comparable CNN config against the pinned
         # (non-measured) P100 estimate — see vs_baseline_note.
@@ -327,6 +387,7 @@ def main():
             "throughput on the cnn config (reference publishes no "
             "throughput numbers); fixed across rounds for comparability"
         ),
+        "nasnet_windowed": nasnet_windowed,
         "nasnet": nasnet,
         "cnn": cnn,
         "round_robin_cnn": round_robin,

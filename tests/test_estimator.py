@@ -640,3 +640,68 @@ def test_nondeterministic_generator_rebuild_error(tmp_path):
     )
     with pytest.raises(ValueError, match="deterministic"):
         renamed.train(linear_dataset(), max_steps=200)
+
+
+def test_metric_fn_adds_custom_eval_metrics(tmp_path):
+    """metric_fn(logits, labels) -> extra metrics surfaced by evaluate()
+    (the reference Estimator's `metric_fn` kwarg, estimator.py:604-759)."""
+    import jax.numpy as jnp
+
+    def metric_fn(logits, labels):
+        return {"mean_abs_logit": jnp.mean(jnp.abs(logits))}
+
+    est = _make_estimator(tmp_path, max_iterations=1, metric_fn=metric_fn)
+    est.train(linear_dataset(), max_steps=100)
+    metrics = est.evaluate(linear_dataset())
+    assert "mean_abs_logit" in metrics
+    assert np.isfinite(metrics["mean_abs_logit"])
+    assert metrics["mean_abs_logit"] > 0
+
+
+def test_metric_fn_weighted_form_sees_weights(tmp_path):
+    """The 3-arg metric_fn form opts into example weights from the
+    weight_key column (reference weight_column semantics,
+    ensemble_builder.py:571-583)."""
+    import jax.numpy as jnp
+
+    def metric_fn(logits, labels, weights):
+        return {"weight_total_mean": jnp.mean(weights)}
+
+    def weighted_dataset():
+        base = linear_dataset()
+
+        def input_fn():
+            for features, labels in base():
+                features = dict(features)
+                features["w"] = np.full(
+                    (len(labels), 1), 2.0, dtype=np.float32
+                )
+                yield features, labels
+
+        return input_fn
+
+    est = _make_estimator(
+        tmp_path, max_iterations=1, metric_fn=metric_fn, weight_key="w"
+    )
+    est.train(weighted_dataset(), max_steps=50)
+    metrics = est.evaluate(weighted_dataset())
+    assert metrics["weight_total_mean"] == pytest.approx(2.0)
+
+
+def test_enable_summaries_false_writes_no_event_files(tmp_path):
+    """With summaries disabled, no tfevents land anywhere under model_dir
+    (the reference's summaries-off coverage, estimator_test.py:1796-2085)."""
+    est = _make_estimator(
+        tmp_path,
+        max_iterations=1,
+        enable_summaries=False,
+        log_every_steps=2,
+    )
+    est.train(linear_dataset(), max_steps=100)
+    event_files = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(str(tmp_path / "model"))
+        for f in files
+        if "tfevents" in f
+    ]
+    assert event_files == []

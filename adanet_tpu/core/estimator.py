@@ -774,6 +774,11 @@ class Estimator:
         try:
             batch = next(data_iter)
         except StopIteration:
+            # Release the exhausted iterator's bookkeeping before
+            # replacing it — a long search crosses many epoch boundaries
+            # and must not retain every dead prefetcher until train()
+            # returns.
+            self._close_iter(data_iter)
             data_iter = self._make_train_iter(input_fn)
             try:
                 batch = next(data_iter)

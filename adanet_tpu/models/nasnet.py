@@ -74,6 +74,11 @@ class NasNetConfig:
     total_training_steps: int = 937500
     stem_type: str = "cifar"  # or "imagenet"
     compute_dtype: Any = jnp.bfloat16
+    # Rematerialize each cell in the backward pass (jax.checkpoint): the
+    # classic TPU HBM-for-FLOPs trade — activation memory drops from
+    # O(cells) to O(1) cells, enabling much larger batches (better MXU
+    # tiling), at the cost of one extra forward per cell in backward.
+    remat: bool = False
 
 
 def calc_reduction_layers(
@@ -427,7 +432,15 @@ class NasNetA(nn.Module):
                     _REDUCTION_USED_HIDDENSTATES,
                 ),
             }[kind]
-            return _NasNetCell(
+            # static_argnums counts self: (self, net, prev, training,
+            # progress) -> `training` (a Python bool steering module
+            # structure) is index 3.
+            cell_cls = (
+                nn.remat(_NasNetCell, static_argnums=(3,))
+                if cfg.remat
+                else _NasNetCell
+            )
+            return cell_cls(
                 operations=spec[0],
                 hiddenstate_indices=spec[1],
                 used_hiddenstates=spec[2],

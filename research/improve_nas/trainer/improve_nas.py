@@ -56,6 +56,10 @@ class Hparams:
     total_training_steps: int = 937500
     stem_multiplier: float = 3.0
     compute_dtype: Any = jnp.bfloat16
+    # Per-cell rematerialization (models/nasnet.py NasNetConfig.remat):
+    # trades one extra forward per cell in backward for O(1)-cell
+    # activation memory, unlocking larger per-chip batches on TPU.
+    remat: bool = False
 
     def replace(self, **kwargs) -> "Hparams":
         return dataclasses.replace(self, **kwargs)
@@ -150,6 +154,7 @@ class Builder(BuilderBase):
             aux_head_weight=hp.aux_head_weight,
             total_training_steps=hp.total_training_steps,
             compute_dtype=hp.compute_dtype,
+            remat=hp.remat,
         )
         return _NasNetSubnetworkModule(config)
 

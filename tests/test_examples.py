@@ -92,3 +92,30 @@ def test_simple_dnn_multihead_support():
     out = module.apply(variables, features, training=False)
     assert set(out.logits) == {"reg", "cls"}
     assert out.logits["cls"].shape == (4, 3)
+
+
+@pytest.mark.slow
+def test_adanet_objective_tutorial_lambda_flips_selection(tmp_path):
+    """The objective tutorial's teaching claim, pinned: with lambda=0 the
+    search grows deep members; with lambda=1 the complexity penalty
+    prices the deep candidates out and shallow members win (reference:
+    adanet/examples/tutorials/adanet_objective.ipynb)."""
+    from adanet_tpu.examples.tutorials.adanet_objective import main
+
+    results = main(
+        [
+            "--steps",
+            "120",
+            "--train_size",
+            "1024",
+            "--lambdas",
+            "0.0,1.0",
+            "--model_dir",
+            str(tmp_path / "objective"),
+        ]
+    )
+    free_members, _ = results[0.0]
+    priced_members, _ = results[1.0]
+    assert any("2_layer" in m or "3_layer" in m for m in free_members)
+    assert priced_members  # all() below must not pass vacuously
+    assert all("1_layer" in m for m in priced_members)

@@ -414,10 +414,18 @@ class NasNetA(nn.Module):
         if training and not self.is_initializing():
             step.value = step.value + 1.0
 
+        if cfg.stem_type not in ("cifar", "imagenet"):
+            raise ValueError(
+                "stem_type must be 'cifar' or 'imagenet', got %r"
+                % (cfg.stem_type,)
+            )
+        num_stem_cells = 2 if cfg.stem_type == "imagenet" else 0
         reduction_indices = calc_reduction_layers(
             cfg.num_cells, cfg.num_reduction_layers
         )
-        total_num_cells = cfg.num_cells + cfg.num_reduction_layers
+        total_num_cells = (
+            cfg.num_cells + cfg.num_reduction_layers + num_stem_cells
+        )
 
         def make_cell(kind, filters, stride, cell_num, name):
             spec = {
@@ -453,24 +461,56 @@ class NasNetA(nn.Module):
                 name=name,
             )
 
-        # CIFAR stem: plain 3x3 conv + bn (reference: nasnet.py:288-297).
-        stem_filters = int(cfg.num_conv_filters * cfg.stem_multiplier)
-        net = nn.Conv(
-            stem_filters,
-            (3, 3),
-            use_bias=False,
-            dtype=cfg.compute_dtype,
-            name="stem_conv",
-        )(x)
-        net = _batch_norm(net, training, "stem_bn")
-        cell_outputs: List[Optional[jnp.ndarray]] = [None, net]
+        true_cell_num = 0
+        if cfg.stem_type == "imagenet":
+            # ImageNet stem: stride-2 VALID conv to halve the input, then
+            # two stride-2 stem reduction cells with sub-unit filter
+            # scaling (reference: nasnet.py:260-286) — 8x spatial
+            # reduction before the main cell stack.
+            stem_filters = int(32 * cfg.stem_multiplier)
+            net = nn.Conv(
+                stem_filters,
+                (3, 3),
+                strides=(2, 2),
+                padding="VALID",
+                use_bias=False,
+                dtype=cfg.compute_dtype,
+                name="conv0",
+            )(x)
+            net = _batch_norm(net, training, "conv0_bn")
+            cell_outputs: List[Optional[jnp.ndarray]] = [None, net]
+            stem_scaling = 1.0 / (
+                cfg.filter_scaling_rate**num_stem_cells
+            )
+            for stem_num in range(num_stem_cells):
+                net = make_cell(
+                    "reduction",
+                    max(1, int(cfg.num_conv_filters * stem_scaling)),
+                    2,
+                    true_cell_num,
+                    "cell_stem_%d" % stem_num,
+                )(net, cell_outputs[-2], training, progress)
+                cell_outputs.append(net)
+                stem_scaling *= cfg.filter_scaling_rate
+                true_cell_num += 1
+        else:
+            # CIFAR stem: plain 3x3 conv + bn (reference: nasnet.py:288-297).
+            stem_filters = int(cfg.num_conv_filters * cfg.stem_multiplier)
+            net = nn.Conv(
+                stem_filters,
+                (3, 3),
+                use_bias=False,
+                dtype=cfg.compute_dtype,
+                name="stem_conv",
+            )(x)
+            net = _batch_norm(net, training, "stem_bn")
+            cell_outputs = [None, net]
 
         aux_logits = None
         aux_cell_index = (
             reduction_indices[1] - 1 if len(reduction_indices) >= 2 else -1
         )
         filter_scaling = 1.0
-        true_cell_num = 0
         for cell_num in range(cfg.num_cells):
             if cell_num in reduction_indices:
                 filter_scaling *= cfg.filter_scaling_rate

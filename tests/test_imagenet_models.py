@@ -146,3 +146,53 @@ def test_resnet_and_efficientnet_search_lifecycle(tmp_path):
     # proof lives in test_convergence.py on cheaper candidates).
     assert np.isfinite(metrics["average_loss"])
     assert np.isfinite(metrics["accuracy"])
+
+
+def test_nasnet_imagenet_stem():
+    """NASNet-A with the ImageNet stem (reference: nasnet.py:260-286 via
+    build_nasnet_mobile): stride-2 VALID conv0 + two stride-2 stem
+    reduction cells (8x spatial reduction) before the main stack."""
+    import jax
+    import jax.numpy as jnp
+
+    from adanet_tpu.models.nasnet import NasNetA, NasNetConfig
+
+    model = NasNetA(
+        NasNetConfig(
+            num_classes=10,
+            num_cells=3,
+            num_conv_filters=8,
+            use_aux_head=False,
+            drop_path_keep_prob=1.0,
+            dense_dropout_keep_prob=1.0,
+            compute_dtype=jnp.float32,
+            stem_type="imagenet",
+        )
+    )
+    images = np.zeros((2, 64, 64, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), images, training=False)
+    params = variables["params"]
+    assert "conv0" in params and "cell_stem_0" in params
+    assert "cell_stem_1" in params and "stem_conv" not in params
+    logits, aux, pooled = model.apply(variables, images, training=False)
+    assert logits.shape == (2, 10)
+    assert aux is None
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_nasnet_rejects_unknown_stem():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from adanet_tpu.models.nasnet import NasNetA, NasNetConfig
+
+    model = NasNetA(
+        NasNetConfig(num_classes=10, stem_type="mobilenet")
+    )
+    with pytest.raises(ValueError, match="stem_type"):
+        model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 32, 32, 3), np.float32),
+            training=False,
+        )

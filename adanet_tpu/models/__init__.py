@@ -4,7 +4,14 @@ from adanet_tpu.models.efficientnet import (
     EfficientNet,
     EfficientNetBuilder,
 )
-from adanet_tpu.models.nasnet import NasNetA, NasNetConfig, calc_reduction_layers
+from adanet_tpu.models.nasnet import (
+    NasNetA,
+    NasNetConfig,
+    calc_reduction_layers,
+    cifar_config,
+    large_imagenet_config,
+    mobile_imagenet_config,
+)
 from adanet_tpu.models.resnet import ResNet, ResNetBuilder
 from adanet_tpu.models.transformer import (
     TransformerBuilder,
@@ -17,6 +24,9 @@ __all__ = [
     "EfficientNetBuilder",
     "NasNetA",
     "NasNetConfig",
+    "cifar_config",
+    "large_imagenet_config",
+    "mobile_imagenet_config",
     "ResNet",
     "ResNetBuilder",
     "TransformerBuilder",

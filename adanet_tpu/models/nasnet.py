@@ -81,6 +81,44 @@ class NasNetConfig:
     remat: bool = False
 
 
+def cifar_config(**overrides) -> NasNetConfig:
+    """NASNet-A (6@768)-family CIFAR preset (reference: nasnet.py
+    cifar_config) — these ARE `NasNetConfig`'s defaults."""
+    return dataclasses.replace(NasNetConfig(), **overrides)
+
+
+def mobile_imagenet_config(**overrides) -> NasNetConfig:
+    """NASNet-A Mobile ImageNet preset (reference: nasnet.py
+    mobile_imagenet_config via build_nasnet_mobile)."""
+    base = NasNetConfig(
+        num_classes=1001,
+        num_cells=12,
+        num_conv_filters=44,
+        stem_multiplier=1.0,
+        drop_path_keep_prob=1.0,
+        dense_dropout_keep_prob=0.5,
+        total_training_steps=250000,
+        stem_type="imagenet",
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+def large_imagenet_config(**overrides) -> NasNetConfig:
+    """NASNet-A Large ImageNet preset (reference: nasnet.py
+    large_imagenet_config via build_nasnet_large)."""
+    base = NasNetConfig(
+        num_classes=1001,
+        num_cells=18,
+        num_conv_filters=168,
+        stem_multiplier=3.0,
+        drop_path_keep_prob=0.7,
+        dense_dropout_keep_prob=0.5,
+        total_training_steps=250000,
+        stem_type="imagenet",
+    )
+    return dataclasses.replace(base, **overrides)
+
+
 def calc_reduction_layers(
     num_cells: int, num_reduction_layers: int
 ) -> List[int]:

@@ -60,6 +60,10 @@ class Hparams:
     # trades one extra forward per cell in backward for O(1)-cell
     # activation memory, unlocking larger per-chip batches on TPU.
     remat: bool = False
+    # "cifar" or "imagenet" (models/nasnet.py stem_type; reference:
+    # nasnet.py:260-298) — the ImageNet stem adds an 8x spatial
+    # reduction before the main cell stack for 224x224-class inputs.
+    stem_type: str = "cifar"
 
     def replace(self, **kwargs) -> "Hparams":
         return dataclasses.replace(self, **kwargs)
@@ -155,6 +159,7 @@ class Builder(BuilderBase):
             total_training_steps=hp.total_training_steps,
             compute_dtype=hp.compute_dtype,
             remat=hp.remat,
+            stem_type=hp.stem_type,
         )
         return _NasNetSubnetworkModule(config)
 

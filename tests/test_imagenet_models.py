@@ -152,9 +152,6 @@ def test_nasnet_imagenet_stem():
     """NASNet-A with the ImageNet stem (reference: nasnet.py:260-286 via
     build_nasnet_mobile): stride-2 VALID conv0 + two stride-2 stem
     reduction cells (8x spatial reduction) before the main stack."""
-    import jax
-    import jax.numpy as jnp
-
     from adanet_tpu.models.nasnet import NasNetA, NasNetConfig
 
     model = NasNetA(
@@ -181,10 +178,6 @@ def test_nasnet_imagenet_stem():
 
 
 def test_nasnet_rejects_unknown_stem():
-    import jax
-    import jax.numpy as jnp
-    import pytest
-
     from adanet_tpu.models.nasnet import NasNetA, NasNetConfig
 
     model = NasNetA(
@@ -196,3 +189,28 @@ def test_nasnet_rejects_unknown_stem():
             np.zeros((1, 32, 32, 3), np.float32),
             training=False,
         )
+
+
+def test_nasnet_imagenet_presets():
+    """Mobile/large ImageNet presets match the reference hparams
+    (reference: nasnet.py mobile_imagenet_config/large_imagenet_config)."""
+    from adanet_tpu.models import (
+        cifar_config,
+        large_imagenet_config,
+        mobile_imagenet_config,
+    )
+
+    mobile = mobile_imagenet_config()
+    assert (mobile.num_cells, mobile.num_conv_filters) == (12, 44)
+    assert mobile.stem_multiplier == 1.0
+    assert mobile.stem_type == "imagenet"
+    assert mobile.dense_dropout_keep_prob == 0.5
+
+    large = large_imagenet_config(num_classes=100)
+    assert (large.num_cells, large.num_conv_filters) == (18, 168)
+    assert large.drop_path_keep_prob == 0.7
+    assert large.num_classes == 100  # overrides apply
+
+    cifar = cifar_config()
+    assert (cifar.num_cells, cifar.num_conv_filters) == (18, 32)
+    assert cifar.stem_type == "cifar"

@@ -265,7 +265,10 @@ class RandomSearchTuner(Tuner):
             # each claim their own slot even under concurrent reports.
             for i, (trial_hparams, trial_score) in enumerate(self._trials):
                 if trial_hparams == hparams and trial_score is None:
-                    self._trials[i] = (hparams, float(score))
+                    # Copy: a caller mutating hparams after reporting must
+                    # not corrupt the scored history (create_trial/trials/
+                    # best_trial already copy).
+                    self._trials[i] = (dict(hparams), float(score))
                     return
 
     def best_trial(self) -> Optional[Tuple[Dict[str, Any], float]]:

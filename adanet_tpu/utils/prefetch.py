@@ -96,3 +96,12 @@ class PrefetchIterator:
         except queue.Empty:
             pass
         self._exhausted = True
+        # Wake a consumer blocked in __next__'s queue.get(): with the
+        # queue just drained and the worker exiting via _put's stop check,
+        # nothing else would ever be enqueued. The queue was emptied above
+        # so there is room; if another thread raced an item in, the
+        # consumer is not blocked and the sentinel is simply surplus.
+        try:
+            self._queue.put_nowait(self._END)
+        except queue.Full:
+            pass

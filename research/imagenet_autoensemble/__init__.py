@@ -1,0 +1,9 @@
+"""ImageNet AutoEnsemble workload (BASELINE.json config 5).
+
+The reference repo trains its improve_nas searches on CIFAR only; config 5
+of BASELINE.json extends the same AutoEnsemble machinery to ImageNet-class
+candidates (ResNet-50 + EfficientNet-B0 under RoundRobin candidate
+parallelism). This package provides the input pipeline over the standard
+ImageNet folder layout and the trainer CLI wiring those candidates through
+`adanet_tpu.AutoEnsembleEstimator`.
+"""

@@ -1,0 +1,186 @@
+"""ImageNet AutoEnsemble trainer CLI (BASELINE.json config 5).
+
+Wires ResNet-50 + EfficientNet-B0 candidates through
+`adanet_tpu.AutoEnsembleEstimator` with optional RoundRobin candidate
+parallelism — the ImageNet-class analogue of the CIFAR trainer
+(research/improve_nas/trainer/trainer.py; reference:
+research/improve_nas/trainer/trainer.py:42-95).
+
+Examples:
+    # Synthetic smoke run (no data needed; small candidates):
+    python -m research.imagenet_autoensemble.trainer \
+        --dataset=fake --image_size=32 --resnet_depth=18 --resnet_width=8 \
+        --boosting_iterations=1 --train_steps=10 --batch_size=16
+
+    # Real run over an extracted ImageNet tree with RoundRobin placement:
+    python -m research.imagenet_autoensemble.trainer \
+        --dataset=imagenet --data_dir=/data/imagenet \
+        --placement=round_robin --batch_size=256 --train_steps=250000
+"""
+
+from __future__ import annotations
+
+import json
+
+from absl import app, flags, logging
+
+import optax
+
+import adanet_tpu
+from adanet_tpu.autoensemble import AutoEnsembleSubestimator
+from adanet_tpu.distributed.placement import RoundRobinStrategy
+from adanet_tpu.ensemble import (
+    ComplexityRegularizedEnsembler,
+    GrowStrategy,
+    MixtureWeightType,
+)
+from adanet_tpu.models.efficientnet import EfficientNet
+from adanet_tpu.models.resnet import ResNet
+
+from research.imagenet_autoensemble import imagenet_data
+
+FLAGS = flags.FLAGS
+
+flags.DEFINE_string(
+    "model_dir", "/tmp/imagenet_autoensemble", "Model directory."
+)
+flags.DEFINE_string("dataset", "fake", "Dataset: imagenet or fake.")
+flags.DEFINE_string(
+    "data_dir", "", "Extracted ImageNet root (train/<class>/*.JPEG)."
+)
+flags.DEFINE_integer("image_size", 224, "Input resolution.")
+flags.DEFINE_integer("batch_size", 64, "Per-step global batch size.")
+flags.DEFINE_integer("train_steps", 250000, "Total training steps.")
+flags.DEFINE_integer("boosting_iterations", 3, "AdaNet iterations.")
+flags.DEFINE_string(
+    "candidates",
+    "resnet50,efficientnet_b0",
+    "Comma list from: resnet50, efficientnet_b0.",
+)
+flags.DEFINE_integer("resnet_depth", 50, "ResNet depth (18/34/50/101).")
+flags.DEFINE_integer("resnet_width", 64, "ResNet base width.")
+flags.DEFINE_string("efficientnet_variant", "b0", "EfficientNet variant.")
+flags.DEFINE_string(
+    "placement", "replication", "Placement: replication or round_robin."
+)
+flags.DEFINE_float("adanet_lambda", 0.0, "Complexity penalty lambda.")
+flags.DEFINE_bool(
+    "learn_mixture_weights", False, "Train mixture weights."
+)
+flags.DEFINE_float("resnet_lr", 0.1, "ResNet SGD learning rate.")
+flags.DEFINE_float(
+    "efficientnet_lr", 0.016, "EfficientNet RMSProp learning rate."
+)
+flags.DEFINE_integer("seed", 42, "Random seed.")
+
+
+def _provider():
+    if FLAGS.dataset == "fake":
+        return imagenet_data.SyntheticProvider(
+            num_classes=8,
+            num_examples=max(128, FLAGS.batch_size * 4),
+            batch_size=FLAGS.batch_size,
+            image_size=FLAGS.image_size,
+            seed=FLAGS.seed,
+        )
+    if FLAGS.dataset == "imagenet":
+        return imagenet_data.Provider(
+            FLAGS.data_dir,
+            batch_size=FLAGS.batch_size,
+            image_size=FLAGS.image_size,
+            seed=FLAGS.seed,
+        )
+    raise ValueError("Unknown dataset %r" % FLAGS.dataset)
+
+
+def candidate_pool(num_classes: int, image_size: int):
+    """The config-5 candidate pool, sized to the input resolution.
+
+    Small inputs (CIFAR-scale smoke runs) use the small-input stems the
+    model families provide; full-resolution runs use the published stems.
+    """
+    small = image_size < 100
+    pool = {}
+    for name in [c.strip() for c in FLAGS.candidates.split(",") if c]:
+        if name == "resnet50":
+            pool["resnet%d" % FLAGS.resnet_depth] = AutoEnsembleSubestimator(
+                ResNet(
+                    logits_dimension=num_classes,
+                    depth=FLAGS.resnet_depth,
+                    width=FLAGS.resnet_width,
+                    small_inputs=small,
+                ),
+                optimizer=optax.sgd(FLAGS.resnet_lr, momentum=0.9),
+            )
+        elif name == "efficientnet_b0":
+            pool["efficientnet_%s" % FLAGS.efficientnet_variant] = (
+                AutoEnsembleSubestimator(
+                    EfficientNet(
+                        logits_dimension=num_classes,
+                        variant=FLAGS.efficientnet_variant,
+                        small_inputs=small,
+                    ),
+                    optimizer=optax.rmsprop(
+                        FLAGS.efficientnet_lr, decay=0.9, momentum=0.9
+                    ),
+                )
+            )
+        else:
+            raise ValueError("Unknown candidate %r" % name)
+    if not pool:
+        raise ValueError("empty --candidates")
+    return pool
+
+
+def build_estimator(provider, model_dir: str):
+    placement = (
+        RoundRobinStrategy() if FLAGS.placement == "round_robin" else None
+    )
+    max_iteration_steps = max(
+        1, FLAGS.train_steps // FLAGS.boosting_iterations
+    )
+    return adanet_tpu.AutoEnsembleEstimator(
+        head=adanet_tpu.MultiClassHead(provider.num_classes),
+        candidate_pool=candidate_pool(
+            provider.num_classes, FLAGS.image_size
+        ),
+        max_iteration_steps=max_iteration_steps,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(
+                optimizer=(
+                    optax.sgd(0.01) if FLAGS.learn_mixture_weights else None
+                ),
+                mixture_weight_type=MixtureWeightType.SCALAR,
+                adanet_lambda=FLAGS.adanet_lambda,
+            )
+        ],
+        ensemble_strategies=[GrowStrategy()],
+        max_iterations=FLAGS.boosting_iterations,
+        model_dir=model_dir,
+        random_seed=FLAGS.seed,
+        placement_strategy=placement,
+    )
+
+
+def main(argv):
+    del argv
+    provider = _provider()
+    estimator = build_estimator(provider, FLAGS.model_dir)
+    estimator.train(
+        provider.get_input_fn("train"), max_steps=FLAGS.train_steps
+    )
+    metrics = estimator.evaluate(provider.get_input_fn("test"))
+    logging.info("Final metrics: %s", metrics)
+    print(
+        json.dumps(
+            {
+                k: v
+                for k, v in metrics.items()
+                if isinstance(v, (int, float, str))
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,10 +1,16 @@
-"""Elastic resume runner: train multi-process, resume with a SHRUNK world.
+"""Elastic resume runner: train under a CHANGING world size.
 
-Spawned by `test_distributed.py::test_elastic_shrunk_world_resume`.
-Phase "a" runs a 2-process SPMD search and stops mid-iteration on a
-max_steps budget (the Estimator persists the mid-iteration state).
-Phase "b" resumes the SAME model_dir with a single process — the world
-shrank after a lost host — and runs the search to completion.
+Spawned by `test_distributed.py::test_elastic_shrunk_world_resume` (2→1)
+and `test_elastic_grow_back_resume` (2→1→2). Each invocation runs one
+phase of the same search against a shared model_dir:
+
+    elastic_runner.py <model_dir> <tag> <process_id> <port> <world> <max_steps>
+
+`max_steps` of -1 runs the search to completion; otherwise the phase is
+budget-stopped mid-search (the Estimator persists mid-iteration state).
+Process 0 writes `<tag>.json` with the phase's start/end step and, when
+the search completed, the per-iteration selection sequence read back from
+the `architecture-<t>.json` records plus a final eval loss.
 
 This works because durable state is world-size-agnostic by design: the
 manifest + msgpack payloads are host pytrees (no sharding baked in), and
@@ -12,7 +18,7 @@ manifest + msgpack payloads are host pytrees (no sharding baked in), and
 resuming world has (adanet_tpu/core/estimator.py:1010-1029). The
 reference's cooperative-recovery analogue is checkpoint-mediated restart
 at fixed cluster shape (reference: adanet/core/estimator.py:951-984,
-iteration.py:40-118); shrink-resume goes beyond it.
+iteration.py:40-118); shrink- and grow-back-resume go beyond it.
 
 Each process feeds its LOCAL shard of a fixed 16-row global batch, so the
 global data stream is identical across phases regardless of world size.
@@ -36,13 +42,30 @@ def local_batches(world: int, process_id: int):
         yield {"x": x[lo:hi]}, y[lo:hi]
 
 
+def selection_sequence(model_dir: str):
+    """[(candidate_name, subnetwork list), ...] per completed iteration."""
+    out = []
+    t = 0
+    while True:
+        path = os.path.join(model_dir, "architecture-%d.json" % t)
+        if not os.path.exists(path):
+            return out
+        with open(path) as f:
+            obj = json.load(f)
+        out.append(
+            (obj.get("ensemble_candidate_name"), obj.get("subnetworks"))
+        )
+        t += 1
+
+
 def main():
-    model_dir, phase, process_id, port, world = (
+    model_dir, tag, process_id, port, world, max_steps = (
         sys.argv[1],
         sys.argv[2],
         int(sys.argv[3]),
         sys.argv[4],
         int(sys.argv[5]),
+        int(sys.argv[6]),
     )
 
     import jax
@@ -82,30 +105,25 @@ def main():
     )
 
     start_step = est.latest_global_step()
-    if phase == "a":
-        # Budget-limited: stops mid-iteration 0 and persists state.
-        est.train(
-            lambda: local_batches(world, process_id), max_steps=8
-        )
-        if process_id == 0:
-            with open(os.path.join(model_dir, "phase_a.json"), "w") as f:
-                json.dump({"global_step": est.latest_global_step()}, f)
-    else:
-        # Shrunk world: one process feeds the WHOLE global batch.
-        est.train(lambda: local_batches(world, process_id))
+    est.train(
+        lambda: local_batches(world, process_id),
+        max_steps=None if max_steps < 0 else max_steps,
+    )
+    record = {
+        "resume_start_step": start_step,
+        "final_step": est.latest_global_step(),
+        "final_iteration": est.latest_iteration_number(),
+        "world": world,
+    }
+    if max_steps < 0:  # ran to completion: selection sequence + eval
         metrics = est.evaluate(
             lambda: local_batches(world, process_id), steps=4
         )
-        with open(os.path.join(model_dir, "phase_b.json"), "w") as f:
-            json.dump(
-                {
-                    "resume_start_step": start_step,
-                    "final_step": est.latest_global_step(),
-                    "final_iteration": est.latest_iteration_number(),
-                    "loss": float(metrics["loss"]),
-                },
-                f,
-            )
+        record["loss"] = float(metrics["loss"])
+        record["selection"] = selection_sequence(model_dir)
+    if process_id == 0:
+        with open(os.path.join(model_dir, "%s.json" % tag), "w") as f:
+            json.dump(record, f)
     print("DONE", flush=True)
 
 

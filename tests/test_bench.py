@@ -8,6 +8,53 @@ import sys
 import pytest
 
 
+def test_bench_measures_on_multichip_mesh(monkeypatch):
+    """Round-3 verdict #8: the bench machinery must work the day >1 real
+    chip appears. Runs `_measure_iteration` and `_measure_round_robin`
+    in-process on the suite's 8-device virtual CPU mesh, checking the
+    per-chip accounting and the multi-chip clock gating."""
+    import jax
+
+    assert jax.device_count() == 8  # the conftest virtual mesh
+
+    import bench
+    from adanet_tpu.examples.simple_cnn import CNNBuilder
+
+    monkeypatch.setattr(bench, "WARMUP_STEPS", 1)
+    monkeypatch.setattr(bench, "MEASURE_STEPS", 2)
+
+    fused = bench._measure_iteration(
+        [CNNBuilder(num_blocks=1, channels=8)], batch_size=4
+    )
+    # Per-chip throughput: positive, and the wall-clock-derived field is
+    # reported alongside whichever clock is primary.
+    assert fused["examples_per_sec_per_chip"] > 0
+    assert fused["host_clock_examples_per_sec_per_chip"] > 0
+    assert fused["clock"] in ("device", "host_fallback")
+    if fused["clock"] == "device":
+        assert fused["device_busy_examples_per_sec_per_chip"] > 0
+    else:
+        assert fused["device_busy_examples_per_sec_per_chip"] is None
+
+    rr = bench._measure_round_robin(
+        [
+            CNNBuilder(num_blocks=1, channels=8),
+            CNNBuilder(num_blocks=1, channels=12),
+        ],
+        batch_size=8,
+    )
+    assert rr["examples_per_sec_per_chip"] > 0
+    # On >1 chip the submeshes run CONCURRENTLY: summed device-busy time
+    # over device_count undercounts elapsed, so the primary number must
+    # come from the wall clock (round-3 advisor).
+    assert rr["clock"] in ("host_multichip", "host_fallback")
+    assert rr["host_clock_examples_per_sec_per_chip"] > 0
+    if rr["clock"] == "host_multichip":
+        assert rr["examples_per_sec_per_chip"] == (
+            rr["host_clock_examples_per_sec_per_chip"]
+        )
+
+
 @pytest.mark.slow
 def test_bench_prints_one_json_line():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -52,8 +99,45 @@ def test_bench_prints_one_json_line():
         )
         # Round-3 honesty: report which clock produced the number.
         assert result[config]["clock"] in ("device", "host_fallback")
+        # Round-4: device-busy and wall-clock throughput are distinct
+        # named fields; busy is None whenever the device clock failed.
+        assert "device_busy_examples_per_sec_per_chip" in result[config]
+        assert result[config]["host_clock_examples_per_sec_per_chip"] > 0
+    # Round-4: the label is computed from the benched hyperparameters.
+    assert result["nasnet_windowed"]["model_name"] == "NASNet-A (1@192)"
     # The RoundRobin executor path is benchmarked too (round-2 verdict:
     # per-submesh dispatch overhead must be measured).
     assert result["round_robin_cnn"]["examples_per_sec_per_chip"] > 0
     # On CPU there is no axon tunnel: no timing caveat, no MFU peak.
     assert "timing_caveat" not in result
+
+
+def test_bench_emits_structured_skip_when_backend_unavailable():
+    """Round-3 verdict: a TPU outage must produce a machine-readable
+    record with rc 0 (BENCH_r03 was a bare traceback), with the bench
+    machinery certified on CPU."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # must take the probe branch
+    env["ADANET_BENCH_FORCE_UNAVAILABLE"] = "1"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["skipped"] == "tpu_unavailable"
+    assert result["cpu_contract_ok"] is True, result
+    assert result["value"] is None
+    for key in ("metric", "unit", "vs_baseline"):
+        assert key in result, result

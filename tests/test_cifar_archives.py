@@ -1,0 +1,168 @@
+"""Real-archive loader coverage without real data (round-3 verdict #6).
+
+Writes synthetic `cifar-10-batches-py` / `cifar-100-python` pickle
+archives — byte-layout-identical to the published ones (uint8 rows of
+3072 channel-major bytes, `b'labels'` / `b'fine_labels'` keys; reference:
+research/improve_nas/trainer/cifar10.py:38-157) — into a tmpdir and runs
+the actual `Provider._load` → augment → train path on them, so the one
+previously-untested I/O seam (file discovery, pickle decode, CHW→HWC
+transpose, label-key fallback) is exercised end to end.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+
+def _write_cifar10_archive(root, examples_per_batch=8, seed=0):
+    """An extracted cifar-10-python.tar.gz: 5 train batches + test batch."""
+    base = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(base, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    expected = {}
+    names = ["data_batch_%d" % i for i in range(1, 6)] + ["test_batch"]
+    for name in names:
+        data = rng.randint(
+            0, 256, size=(examples_per_batch, 3072), dtype=np.uint8
+        )
+        labels = rng.randint(0, 10, size=examples_per_batch).tolist()
+        with open(os.path.join(base, name), "wb") as f:
+            # The published archives are python-2 pickles of byte-keyed
+            # dicts; protocol 2 + bytes keys reproduces that layout.
+            pickle.dump({b"data": data, b"labels": labels}, f, protocol=2)
+        expected[name] = (data, np.asarray(labels, np.int32))
+    return expected
+
+
+def _write_cifar100_archive(root, examples=12, seed=1):
+    base = os.path.join(root, "cifar-100-python")
+    os.makedirs(base, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    expected = {}
+    for name in ("train", "test"):
+        data = rng.randint(0, 256, size=(examples, 3072), dtype=np.uint8)
+        fine = rng.randint(0, 100, size=examples).tolist()
+        coarse = rng.randint(0, 20, size=examples).tolist()
+        with open(os.path.join(base, name), "wb") as f:
+            pickle.dump(
+                {
+                    b"data": data,
+                    b"fine_labels": fine,
+                    b"coarse_labels": coarse,
+                },
+                f,
+                protocol=2,
+            )
+        expected[name] = (data, np.asarray(fine, np.int32))
+    return expected
+
+
+def test_cifar10_load_matches_archive_bytes(tmp_path):
+    """_load concatenates the 5 train batches in order, decodes CHW→HWC."""
+    from research.improve_nas.trainer import cifar10
+
+    expected = _write_cifar10_archive(str(tmp_path))
+    provider = cifar10.Provider(str(tmp_path), batch_size=4)
+
+    images, labels = provider._load("train")
+    assert images.shape == (40, 32, 32, 3)
+    assert images.dtype == np.float32
+    raw = np.concatenate(
+        [expected["data_batch_%d" % i][0] for i in range(1, 6)], axis=0
+    )
+    want = raw.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1) / 255.0
+    np.testing.assert_allclose(images, want.astype(np.float32))
+    want_labels = np.concatenate(
+        [expected["data_batch_%d" % i][1] for i in range(1, 6)]
+    )
+    np.testing.assert_array_equal(labels, want_labels)
+
+    test_images, test_labels = provider._load("test")
+    assert test_images.shape == (8, 32, 32, 3)
+    np.testing.assert_array_equal(test_labels, expected["test_batch"][1])
+
+
+def test_cifar10_input_fn_augments_and_batches(tmp_path):
+    """The full _load → augment → standardize train path off the archive."""
+    from research.improve_nas.trainer import cifar10
+
+    _write_cifar10_archive(str(tmp_path), examples_per_batch=16)
+    provider = cifar10.Provider(str(tmp_path), batch_size=16, seed=7)
+
+    batches = list(provider.get_input_fn("train")())
+    # 80 train examples at batch 16.
+    assert len(batches) == 5
+    for features, labels in batches:
+        assert features["image"].shape == (16, 32, 32, 3)
+        assert labels.shape == (16,)
+        assert features["image"].dtype == np.float32
+        # Standardized: not in [0, 1].
+        assert features["image"].min() < 0
+
+    # Eval path: deterministic, unaugmented, standardization-only.
+    eval_a = list(provider.get_input_fn("test")())
+    eval_b = list(provider.get_input_fn("test", shuffle=False)())
+    assert len(eval_a) == 1
+    np.testing.assert_array_equal(
+        eval_a[0][0]["image"], eval_b[0][0]["image"]
+    )
+
+
+def test_cifar10_missing_files_error_names_them(tmp_path):
+    from research.improve_nas.trainer import cifar10
+
+    provider = cifar10.Provider(str(tmp_path), batch_size=4)
+    with pytest.raises(FileNotFoundError, match="data_batch_1"):
+        provider._load("train")
+
+
+def test_cifar100_load_fine_labels(tmp_path):
+    """CIFAR-100 archive layout: single train/test files, b'fine_labels'."""
+    from research.improve_nas.trainer import cifar100
+
+    expected = _write_cifar100_archive(str(tmp_path))
+    provider = cifar100.Provider(str(tmp_path), batch_size=4)
+
+    images, labels = provider._load("train")
+    assert images.shape == (12, 32, 32, 3)
+    np.testing.assert_array_equal(labels, expected["train"][1])
+
+    batches = list(provider.get_input_fn("train")())
+    assert len(batches) == 3
+    assert batches[0][0]["image"].shape == (4, 32, 32, 3)
+
+
+def test_cifar10_archive_trains_an_estimator(tmp_path):
+    """The archive feeds a real (tiny) AdaNet search end to end."""
+    import optax
+
+    from adanet_tpu.core.estimator import Estimator
+    from adanet_tpu.core.heads import MultiClassHead
+    from adanet_tpu.examples.simple_dnn import Generator
+    from research.improve_nas.trainer import cifar10
+
+    _write_cifar10_archive(str(tmp_path), examples_per_batch=8)
+    provider = cifar10.Provider(str(tmp_path), batch_size=8)
+
+    def flatten_input_fn():
+        for features, labels in provider.get_input_fn("train")():
+            yield (
+                {"x": features["image"].reshape(len(labels), -1)},
+                labels,
+            )
+
+    estimator = Estimator(
+        head=MultiClassHead(n_classes=10),
+        subnetwork_generator=Generator(
+            optimizer_fn=lambda: optax.sgd(0.01),
+            layer_size=8,
+            seed=0,
+        ),
+        max_iteration_steps=5,
+        model_dir=str(tmp_path / "model"),
+    )
+    estimator.train(flatten_input_fn, max_steps=5)
+    metrics = estimator.evaluate(flatten_input_fn, steps=2)
+    assert "loss" in metrics and np.isfinite(metrics["loss"])

@@ -677,19 +677,35 @@ def test_elastic_shrunk_world_resume(tmp_path):
     world shrank after a lost host — from the same model_dir. Works because
     durable state is world-size-agnostic host pytrees re-replicated onto
     whatever mesh the resuming world has (core/estimator.py:1010-1029)."""
+    model_dir = str(tmp_path / "elastic_model")
+    os.makedirs(model_dir)
+
+    # Phase a: 2-process SPMD, stopped by budget mid-iteration 0.
+    phase_a = _run_elastic_phase(model_dir, "phase_a", world=2, max_steps=8)
+    assert phase_a["final_step"] == 8
+
+    # Phase b: ONE process resumes the same model_dir and finishes.
+    phase_b = _run_elastic_phase(model_dir, "phase_b", world=1, max_steps=-1)
+    assert phase_b["resume_start_step"] == 8  # continued, not restarted
+    assert phase_b["final_step"] == 40  # 2 iterations x 20 steps
+    assert phase_b["final_iteration"] == 2
+    assert np.isfinite(phase_b["loss"])
+
+
+def _run_elastic_phase(model_dir, tag, world, max_steps, timeout=600):
+    """Spawns `world` elastic_runner.py processes for one search phase and
+    returns the record process 0 wrote."""
     import json
     import socket
     import subprocess
     import sys
 
     runner = os.path.join(os.path.dirname(__file__), "elastic_runner.py")
-    model_dir = str(tmp_path / "elastic_model")
-    os.makedirs(model_dir)
     with socket.socket() as sock:
         sock.bind(("localhost", 0))
         port = sock.getsockname()[1]
 
-    def spawn(phase, index, world):
+    def spawn(index):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
         env.pop("XLA_FLAGS", None)
@@ -698,30 +714,66 @@ def test_elastic_shrunk_world_resume(tmp_path):
             [os.path.dirname(tests_dir), tests_dir, env.get("PYTHONPATH", "")]
         )
         return subprocess.Popen(
-            [sys.executable, runner, model_dir, phase, str(index), str(port), str(world)],
+            [
+                sys.executable,
+                runner,
+                model_dir,
+                tag,
+                str(index),
+                str(port),
+                str(world),
+                str(max_steps),
+            ],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
         )
 
-    # Phase a: 2-process SPMD, stopped by budget mid-iteration 0.
-    procs = [spawn("a", i, 2) for i in range(2)]
+    procs = [spawn(i) for i in range(world)]
     for i, proc in enumerate(procs):
-        out, _ = proc.communicate(timeout=600)
-        assert proc.returncode == 0, (i, out.decode()[-3000:])
+        out, _ = proc.communicate(timeout=timeout)
+        assert proc.returncode == 0, (tag, i, out.decode()[-3000:])
         assert b"DONE" in out
-    phase_a = json.load(open(os.path.join(model_dir, "phase_a.json")))
-    assert phase_a["global_step"] == 8
+    with open(os.path.join(model_dir, "%s.json" % tag)) as f:
+        return json.load(f)
 
-    # Phase b: ONE process resumes the same model_dir and finishes.
-    proc = spawn("b", 0, 1)
-    out, _ = proc.communicate(timeout=600)
-    assert proc.returncode == 0, out.decode()[-3000:]
-    phase_b = json.load(open(os.path.join(model_dir, "phase_b.json")))
-    assert phase_b["resume_start_step"] == 8  # continued, not restarted
-    assert phase_b["final_step"] == 40  # 2 iterations x 20 steps
-    assert phase_b["final_iteration"] == 2
-    assert np.isfinite(phase_b["loss"])
+
+def test_elastic_grow_back_resume(tmp_path):
+    """The realistic preemption sequel (round-3 verdict #7): 2 processes →
+    lose one mid-iteration 0 → 1 process continues into iteration 1 →
+    the host RETURNS and 2 processes finish the search. The re-expanded
+    run's per-iteration selection sequence must match a never-shrunk
+    single-world oracle over the same global data stream."""
+    model_dir = str(tmp_path / "elastic_model")
+    os.makedirs(model_dir)
+
+    # 2 procs, budget-stopped mid-iteration 0 (8 < 20 steps).
+    phase_a = _run_elastic_phase(model_dir, "phase_a", world=2, max_steps=8)
+    assert (phase_a["final_step"], phase_a["final_iteration"]) == (8, 0)
+
+    # Shrunk world: 1 proc continues across the iteration boundary into
+    # iteration 1 (28 = 20 + 8), freezing iteration 0's selection.
+    phase_b = _run_elastic_phase(model_dir, "phase_b", world=1, max_steps=28)
+    assert phase_b["resume_start_step"] == 8
+    assert (phase_b["final_step"], phase_b["final_iteration"]) == (28, 1)
+
+    # Grown back: 2 procs finish the search.
+    phase_c = _run_elastic_phase(model_dir, "phase_c", world=2, max_steps=-1)
+    assert phase_c["resume_start_step"] == 28
+    assert phase_c["final_step"] == 40
+    assert phase_c["final_iteration"] == 2
+    assert np.isfinite(phase_c["loss"])
+
+    # Never-shrunk oracle: the same search straight through at world=1
+    # (the global data stream is world-size-invariant by construction).
+    oracle_dir = str(tmp_path / "oracle_model")
+    os.makedirs(oracle_dir)
+    oracle = _run_elastic_phase(oracle_dir, "oracle", world=1, max_steps=-1)
+    assert phase_c["selection"], phase_c
+    assert phase_c["selection"] == oracle["selection"], (
+        phase_c["selection"],
+        oracle["selection"],
+    )
 
 
 def test_estimator_with_round_robin_placement(tmp_path):

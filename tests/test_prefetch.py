@@ -6,6 +6,7 @@ overlap for plain-Python input_fns, order-preserving and therefore
 bit-deterministic.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -77,6 +78,39 @@ def test_close_unblocks_parked_worker():
     assert not it._thread.is_alive()
     with pytest.raises(StopIteration):
         next(it)
+
+
+def test_close_from_other_thread_wakes_blocked_consumer():
+    """Round-3 advisor: with the queue empty and the consumer parked in
+    queue.get(), close() from another thread must wake it (the worker
+    exits via _put's stop check without ever enqueuing _END)."""
+
+    release_worker = threading.Event()
+
+    def source():
+        yield 0
+        release_worker.wait(timeout=10)  # keep the queue empty meanwhile
+        yield 1
+
+    it = PrefetchIterator(source(), buffer_size=1)
+    assert next(it) == 0
+
+    result = {}
+
+    def consume():
+        try:
+            result["value"] = next(it)
+        except StopIteration:
+            result["value"] = "stop"
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    time.sleep(0.2)  # let the consumer park in queue.get()
+    it.close()
+    consumer.join(timeout=5.0)
+    release_worker.set()
+    assert not consumer.is_alive(), "consumer stayed blocked after close()"
+    assert result["value"] in ("stop", 1)
 
 
 def test_buffer_size_validation():

@@ -1460,8 +1460,20 @@ class Estimator:
         self._write_eval_summaries(results, info.global_step)
         return results
 
-    def predict(self, input_fn: Callable[[], Iterator]):
-        """Yields per-batch prediction dicts of the best ensemble."""
+    def predict(
+        self, input_fn: Callable[[], Iterator], on_cpu: bool = False
+    ):
+        """Yields per-batch prediction dicts of the best ensemble.
+
+        `on_cpu=True` commits the final ensemble's parameters to the host
+        CPU backend so the whole prediction program executes there — the
+        analogue of the reference's inference fallback for models whose
+        embedding tables cannot live on the accelerator (reference:
+        adanet/core/tpu_estimator.py:180-227, "TPU does not support
+        inference with TPUEmbedding. Falling back to CPU."). Host-RAM
+        resident parameters can exceed HBM; uncommitted (numpy) feature
+        batches follow the committed parameters' placement.
+        """
         data = iter(input_fn())
         try:
             first = next(data)
@@ -1470,6 +1482,9 @@ class Estimator:
         data = itertools.chain([first], data)
         features0 = first[0] if isinstance(first, tuple) else first
         forward, params, _ = self._final_forward_fn((features0, None))
+        if on_cpu:
+            cpu = jax.local_devices(backend="cpu")[0]
+            params = jax.device_put(params, cpu)
 
         @jax.jit
         def predict_fn(params, features):

@@ -51,6 +51,7 @@ class TPUEstimator(Estimator):
         *args,
         iterations_per_loop: int = 16,
         predict_batch_size: Optional[int] = None,
+        embedding_tables_on_host: bool = False,
         **kwargs,
     ):
         super().__init__(
@@ -61,18 +62,37 @@ class TPUEstimator(Estimator):
                 "predict_batch_size must be >= 1 (or 0 to disable)."
             )
         self._predict_batch_size = predict_batch_size
+        # Models whose embedding tables live in host RAM (too large for
+        # HBM) cannot serve on the accelerator; predict() then routes to
+        # the CPU backend automatically — the reference's TPUEmbedding
+        # inference fallback (adanet/core/tpu_estimator.py:180-227).
+        self._embedding_tables_on_host = embedding_tables_on_host
 
     def predict(
         self,
         input_fn: Callable[[], Iterator],
         predict_batch_size: Optional[int] = None,
+        on_cpu: Optional[bool] = None,
     ):
         """Yields per-batch predictions; with a `predict_batch_size`
         (argument or constructor default) every device batch is padded to
         that fixed size so XLA compiles a single inference program, and
         outputs are sliced back to the true row counts. Pass
         `predict_batch_size=0` to disable padding even when the
-        constructor set a default."""
+        constructor set a default.
+
+        `on_cpu` (default: the constructor's `embedding_tables_on_host`)
+        serves from the host CPU backend — the reference's automatic
+        TPUEmbedding inference fallback."""
+        if on_cpu is None:
+            on_cpu = self._embedding_tables_on_host
+            if on_cpu:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "TPU does not serve host-resident embedding tables; "
+                    "predicting on CPU."
+                )
         batch_size = (
             predict_batch_size
             if predict_batch_size is not None
@@ -84,7 +104,7 @@ class TPUEstimator(Estimator):
                 % batch_size
             )
         if not batch_size:
-            yield from super().predict(input_fn)
+            yield from super().predict(input_fn, on_cpu=on_cpu)
             return
 
         import collections
@@ -107,6 +127,6 @@ class TPUEstimator(Estimator):
             arr = np.asarray(x)
             return arr[:n] if arr.ndim >= 1 else arr
 
-        for preds in super().predict(padded_input_fn):
+        for preds in super().predict(padded_input_fn, on_cpu=on_cpu):
             n = sizes.popleft()  # bounded memory on unbounded streams
             yield jax.tree_util.tree_map(lambda x: unpad(x, n), preds)

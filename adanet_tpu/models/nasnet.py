@@ -79,6 +79,11 @@ class NasNetConfig:
     # O(cells) to O(1) cells, enabling much larger batches (better MXU
     # tiling), at the cost of one extra forward per cell in backward.
     remat: bool = False
+    # Route every separable conv through the fused Pallas kernel
+    # (ops/sepconv_kernels.py: relu + depthwise + pointwise in one
+    # VMEM-resident pass; parameters are layout-identical to the Flax
+    # path, so checkpoints interchange). No-op on non-TPU backends.
+    use_pallas_sep_conv: bool = False
 
 
 def cifar_config(**overrides) -> NasNetConfig:
@@ -140,38 +145,73 @@ def _batch_norm(x, training: bool, name: str):
     )(x)
 
 
+class _ConvKernel(nn.Module):
+    """Bare conv kernel parameter, scope-compatible with `nn.Conv`: the
+    param path is `<name>/kernel` with Flax's default initializer, so the
+    fused and unfused sep-conv paths share checkpoints."""
+
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel", nn.initializers.lecun_normal(), self.shape
+        )
+
+
 class _SepConv(nn.Module):
     """Stacked relu -> depthwise+pointwise conv -> bn, repeated
-    (reference: nasnet_utils.py:183-211)."""
+    (reference: nasnet_utils.py:183-211). With `use_pallas` the
+    relu+depthwise+pointwise triple runs as one fused VMEM-resident
+    Pallas kernel (ops/sepconv_kernels.py)."""
 
     filters: int
     kernel: int
     stride: int
     num_layers: int
     compute_dtype: Any
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, x, training: bool):
+        from adanet_tpu.ops.sepconv_kernels import fused_sep_conv
+
         stride = self.stride
         for layer in range(self.num_layers):
-            x = nn.relu(x)
             in_ch = x.shape[-1]
-            x = nn.Conv(
-                features=in_ch,
-                kernel_size=(self.kernel, self.kernel),
-                strides=(stride, stride),
-                feature_group_count=in_ch,
-                use_bias=False,
-                dtype=self.compute_dtype,
-                name="depthwise_%d" % layer,
-            )(x)
-            x = nn.Conv(
-                features=self.filters,
-                kernel_size=(1, 1),
-                use_bias=False,
-                dtype=self.compute_dtype,
-                name="pointwise_%d" % layer,
-            )(x)
+            if self.use_pallas:
+                dw = _ConvKernel(
+                    (self.kernel, self.kernel, 1, in_ch),
+                    name="depthwise_%d" % layer,
+                )()
+                pw = _ConvKernel(
+                    (1, 1, in_ch, self.filters),
+                    name="pointwise_%d" % layer,
+                )()
+                x = fused_sep_conv(
+                    jnp.asarray(x, self.compute_dtype),
+                    jnp.asarray(dw, self.compute_dtype),
+                    jnp.asarray(pw, self.compute_dtype),
+                    stride,
+                )
+            else:
+                x = nn.relu(x)
+                x = nn.Conv(
+                    features=in_ch,
+                    kernel_size=(self.kernel, self.kernel),
+                    strides=(stride, stride),
+                    feature_group_count=in_ch,
+                    use_bias=False,
+                    dtype=self.compute_dtype,
+                    name="depthwise_%d" % layer,
+                )(x)
+                x = nn.Conv(
+                    features=self.filters,
+                    kernel_size=(1, 1),
+                    use_bias=False,
+                    dtype=self.compute_dtype,
+                    name="pointwise_%d" % layer,
+                )(x)
             x = _batch_norm(x, training, "bn_%d" % layer)
             stride = 1
         return x
@@ -245,6 +285,7 @@ class _NasNetCell(nn.Module):
     total_num_cells: int
     drop_path_keep_prob: float
     compute_dtype: Any
+    use_pallas_sep_conv: bool = False
 
     def _apply_operation(
         self, x, operation, stride, is_original_input, training, progress, name
@@ -262,6 +303,7 @@ class _NasNetCell(nn.Module):
                 stride=stride,
                 num_layers=num_layers,
                 compute_dtype=self.compute_dtype,
+                use_pallas=self.use_pallas_sep_conv,
                 name="%s_sep" % name,
             )(x, training)
         elif operation == "none":
@@ -496,6 +538,7 @@ class NasNetA(nn.Module):
                 total_num_cells=total_num_cells,
                 drop_path_keep_prob=cfg.drop_path_keep_prob,
                 compute_dtype=cfg.compute_dtype,
+                use_pallas_sep_conv=cfg.use_pallas_sep_conv,
                 name=name,
             )
 

@@ -285,7 +285,7 @@ def _measure_iteration(
     out = {
         "examples_per_sec_per_chip": round(examples_per_sec_per_chip, 1),
         "device_busy_examples_per_sec_per_chip": (
-            round(MEASURE_STEPS * global_batch / elapsed / num_chips, 1)
+            round(examples_per_sec_per_chip, 1)
             if clock == "device"
             else None
         ),
@@ -466,13 +466,14 @@ def main():
     from research.improve_nas.trainer.improve_nas import Builder as NASBuilder
     from research.improve_nas.trainer.improve_nas import Hparams
 
-    def nasnet_builder():
+    def nasnet_builder(use_pallas_sep_conv=False):
         return NASBuilder(
             optimizer_fn=lambda lr: optax.sgd(lr, momentum=0.9),
             hparams=Hparams(
                 num_cells=NASNET_CELLS,
                 num_conv_filters=NASNET_FILTERS,
                 use_aux_head=False,
+                use_pallas_sep_conv=use_pallas_sep_conv,
             ),
             seed=0,
         )
@@ -495,6 +496,18 @@ def main():
     # advisor: a hand-written "6@768" once described a 3x-smaller model).
     model_name = _nasnet_model_name(NASNET_CELLS, NASNET_FILTERS)
     nasnet["model_name"] = nasnet_windowed["model_name"] = model_name
+
+    # Fused Pallas sep-conv before/after (TPU-only: elsewhere the op
+    # falls back to the identical XLA path and the number is noise).
+    # Same math per step, so the per-step run's FLOPs price this MFU too.
+    nasnet_pallas = None
+    if jax.devices()[0].platform == "tpu":
+        nasnet_pallas = _measure_iteration(
+            [nasnet_builder(use_pallas_sep_conv=True)],
+            batch_size=128,
+            flops_per_example=nasnet["flops_per_example"],
+        )
+        nasnet_pallas["model_name"] = model_name + " + fused sep-conv"
     cnn = _measure_iteration(
         [
             CNNBuilder(num_blocks=2, channels=64),
@@ -530,6 +543,7 @@ def main():
         ),
         "nasnet_windowed": nasnet_windowed,
         "nasnet": nasnet,
+        "nasnet_pallas_sepconv": nasnet_pallas,
         "cnn": cnn,
         "round_robin_cnn": round_robin,
         "device_kind": jax.devices()[0].device_kind,

@@ -64,6 +64,10 @@ class Hparams:
     # nasnet.py:260-298) — the ImageNet stem adds an 8x spatial
     # reduction before the main cell stack for 224x224-class inputs.
     stem_type: str = "cifar"
+    # Fused relu+depthwise+pointwise Pallas kernel for every separable
+    # conv (ops/sepconv_kernels.py); parameter-layout-identical to the
+    # Flax path, no-op off TPU.
+    use_pallas_sep_conv: bool = False
 
     def replace(self, **kwargs) -> "Hparams":
         return dataclasses.replace(self, **kwargs)
@@ -160,6 +164,7 @@ class Builder(BuilderBase):
             compute_dtype=hp.compute_dtype,
             remat=hp.remat,
             stem_type=hp.stem_type,
+            use_pallas_sep_conv=hp.use_pallas_sep_conv,
         )
         return _NasNetSubnetworkModule(config)
 

@@ -7,6 +7,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 import adanet_tpu
 from adanet_tpu import AutoEnsembleEstimator, AutoEnsembleSubestimator
@@ -119,6 +120,89 @@ def test_bagging_per_candidate_input_fn(tmp_path):
     est.train(linear_dataset(), max_steps=8)
     assert seen["count"] >= 1  # the dedicated pipeline was consumed
     assert est.latest_iteration_number() == 1
+
+
+@pytest.mark.slow
+def test_bagging_improves_accuracy(tmp_path, record_gate):
+    """The bagging claim, accuracy-gated (round-3 verdict #4): an
+    AllStrategy ensemble of three bootstrap-bagged MLPs on noisy digit
+    images must beat the best SINGLE bagged member trained identically —
+    the variance reduction that is bagging's whole point."""
+    from adanet_tpu.ensemble import AllStrategy, MeanEnsembler
+    from adanet_tpu.examples.synthetic_digits import make_dataset
+
+    xtr, ytr = make_dataset(2048, seed=3)
+    xte, yte = make_dataset(1024, seed=4)
+    noise_rng = np.random.RandomState(0)
+    flip = noise_rng.rand(len(ytr)) < 0.25  # label noise -> variance
+    ytr = np.where(flip, noise_rng.randint(0, 10, size=len(ytr)), ytr)
+    xtr = xtr.reshape(len(xtr), -1).astype(np.float32)
+    xte = xte.reshape(len(xte), -1).astype(np.float32)
+
+    def stream(x, y, seed, batch=64):
+        def input_fn():
+            rng = np.random.RandomState(seed)
+            idx = rng.randint(0, len(x), size=len(x))  # bootstrap resample
+            for start in range(0, len(idx) - batch + 1, batch):
+                take = idx[start : start + batch]
+                yield {"x": x[take]}, y[take]
+
+        return input_fn
+
+    def eval_stream(batch=64):
+        def input_fn():
+            for start in range(0, len(xte) - batch + 1, batch):
+                yield {"x": xte[start : start + batch]}, yte[
+                    start : start + batch
+                ]
+
+        return input_fn
+
+    def make_members(prefix):
+        return {
+            "%s_%d" % (prefix, k): AutoEnsembleSubestimator(
+                _MLP(out=10),
+                optimizer=optax.adam(2e-3),
+                train_input_fn=stream(xtr, ytr, seed=100 + k),
+            )
+            for k in range(3)
+        }
+
+    def run(pool, strategy, model_dir):
+        est = AutoEnsembleEstimator(
+            head=adanet_tpu.MultiClassHead(n_classes=10),
+            candidate_pool=pool,
+            ensemblers=[MeanEnsembler()],
+            ensemble_strategies=[strategy],
+            max_iteration_steps=150,
+            max_iterations=1,
+            model_dir=model_dir,
+            log_every_steps=0,
+        )
+        est.train(stream(xtr, ytr, seed=9), max_steps=150)
+        return est.evaluate(eval_stream())
+
+    bagged = run(
+        make_members("bag"), AllStrategy(), str(tmp_path / "bagged")
+    )
+    singles = [
+        run(
+            {name: sub},
+            AllStrategy(),
+            str(tmp_path / ("single_%s" % name)),
+        )
+        for name, sub in make_members("bag").items()
+    ]
+    best_single = max(s["accuracy"] for s in singles)
+    record_gate(
+        bagged,
+        best_single_accuracy=float(best_single),
+        single_accuracies=[float(s["accuracy"]) for s in singles],
+    )
+    assert bagged["accuracy"] >= best_single, (
+        bagged["accuracy"],
+        [s["accuracy"] for s in singles],
+    )
 
 
 def test_prediction_only_candidate_never_trains(tmp_path):

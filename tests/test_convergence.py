@@ -46,23 +46,25 @@ def _search(train, test, model_dir, layer_size, steps, iterations, dropout=0.0):
     return est.evaluate(input_fn(xte, yte))
 
 
-def test_search_beats_linear_baseline(tmp_path):
+def test_search_beats_linear_baseline(tmp_path, record_gate):
     """Quick gate: a small 2-iteration search must clear the linear
-    plateau by a wide margin."""
+    plateau by a wide margin (round-3 verdict #4 widened this gate from
+    0.82@200 steps to 0.88@400 steps)."""
     metrics = _search(
         make_dataset(4096, seed=7),
         make_dataset(1024, seed=8),
         str(tmp_path / "model"),
         layer_size=128,
-        steps=200,
+        steps=400,
         iterations=2,
     )
-    assert metrics["accuracy"] >= 0.82, metrics
+    record_gate(metrics, threshold=0.88)
+    assert metrics["accuracy"] >= 0.88, metrics
     assert metrics["accuracy"] > LINEAR_BASELINE_ACCURACY
 
 
 @pytest.mark.slow
-def test_cnn_family_converges(tmp_path):
+def test_cnn_family_converges(tmp_path, record_gate):
     """Conv-family gate (RUN_SLOW=1): a 2-iteration CNN candidate search
     on the digit IMAGES must clear the linear plateau decisively
     (measured 91.9% on the 8-device CPU mesh)."""
@@ -90,12 +92,13 @@ def test_cnn_family_converges(tmp_path):
     )
     est.train(image_input_fn(xtr, ytr), max_steps=10**6)
     metrics = est.evaluate(image_input_fn(xte, yte))
+    record_gate(metrics, threshold=0.89)
     assert metrics["accuracy"] >= 0.89, metrics
     assert metrics["accuracy"] > LINEAR_BASELINE_ACCURACY
 
 
 @pytest.mark.slow
-def test_search_converges_to_target_accuracy(tmp_path):
+def test_search_converges_to_target_accuracy(tmp_path, record_gate):
     """Full gate (RUN_SLOW=1): the 3-iteration simple_dnn search reaches
     >= 94% test accuracy on the deterministic digits problem (measured
     96.0% on the 8-device CPU mesh)."""
@@ -108,12 +111,13 @@ def test_search_converges_to_target_accuracy(tmp_path):
         iterations=3,
         dropout=0.1,
     )
+    record_gate(metrics, threshold=0.94)
     assert metrics["accuracy"] >= 0.94, metrics
     assert metrics["top_5_accuracy"] >= 0.99, metrics
 
 
 @pytest.mark.slow
-def test_nasnet_family_converges(tmp_path):
+def test_nasnet_family_converges(tmp_path, record_gate):
     """Flagship-family gate (RUN_SLOW=1): a small NASNet-A candidate
     search on the digit images must clear the linear plateau decisively
     (reference accuracy contract: research/improve_nas/README.md:41)."""
@@ -148,6 +152,7 @@ def test_nasnet_family_converges(tmp_path):
     )
     est.train(image_input_fn(xtr, ytr), max_steps=10**6)
     metrics = est.evaluate(image_input_fn(xte, yte))
+    record_gate(metrics, threshold=0.88)
     assert metrics["accuracy"] >= 0.88, metrics
     assert metrics["accuracy"] > LINEAR_BASELINE_ACCURACY
 

@@ -95,7 +95,9 @@ def test_simple_dnn_multihead_support():
 
 
 @pytest.mark.slow
-def test_adanet_objective_tutorial_lambda_flips_selection(tmp_path):
+def test_adanet_objective_tutorial_lambda_flips_selection(
+    tmp_path, record_gate
+):
     """The objective tutorial's teaching claim, pinned: with lambda=0 the
     search grows deep members; with lambda=1 the complexity penalty
     prices the deep candidates out and shallow members win (reference:
@@ -116,6 +118,10 @@ def test_adanet_objective_tutorial_lambda_flips_selection(tmp_path):
     )
     free_members, _ = results[0.0]
     priced_members, _ = results[1.0]
+    record_gate(
+        lambda0_members=list(free_members),
+        lambda1_members=list(priced_members),
+    )
     assert any("2_layer" in m or "3_layer" in m for m in free_members)
     assert priced_members  # all() below must not pass vacuously
     assert all("1_layer" in m for m in priced_members)

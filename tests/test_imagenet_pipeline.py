@@ -231,7 +231,7 @@ def test_build_estimator_wires_round_robin(tmp_path):
 
 
 @pytest.mark.slow
-def test_imagenet_autoensemble_convergence_gate(tmp_path):
+def test_imagenet_autoensemble_convergence_gate(tmp_path, record_gate):
     """Config 5 end to end on synthetic images: the AutoEnsemble of the
     two families under RoundRobin learns the class structure (accuracy
     well above the 1/8 chance floor)."""
@@ -252,5 +252,6 @@ def test_imagenet_autoensemble_convergence_gate(tmp_path):
     est = trainer.build_estimator(provider, str(tmp_path / "model"))
     est.train(provider.get_input_fn("train"), max_steps=60)
     metrics = est.evaluate(provider.get_input_fn("test"))
+    record_gate(metrics, threshold=0.5)
     assert np.isfinite(metrics["average_loss"])
     assert metrics["accuracy"] >= 0.5, metrics  # chance is 0.125

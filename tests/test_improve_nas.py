@@ -59,7 +59,7 @@ def _make_estimator(tmp_path, hparams, generator_cls, provider, **kwargs):
 
 
 @pytest.mark.slow
-def test_nasnet_search_end_to_end(tmp_path):
+def test_nasnet_search_end_to_end(tmp_path, record_gate):
     provider = fake_data.FakeImageProvider(batch_size=8, image_size=8)
     est = _make_estimator(
         tmp_path, _tiny_hparams(), improve_nas.Generator, provider
@@ -67,6 +67,7 @@ def test_nasnet_search_end_to_end(tmp_path):
     est.train(provider.get_input_fn("train"), max_steps=100)
     assert est.latest_iteration_number() == 2
     metrics = est.evaluate(provider.get_input_fn("test"))
+    record_gate(metrics)
     assert np.isfinite(metrics["average_loss"])
     assert 0.0 <= metrics["accuracy"] <= 1.0
 

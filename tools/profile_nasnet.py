@@ -71,8 +71,14 @@ def main():
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--batch", type=int, default=128)
     parser.add_argument("--filters", type=int, default=32)
-    parser.add_argument("--cells", type=int, default=6)
+    parser.add_argument("--cells", type=int, default=18)
     parser.add_argument("--top", type=int, default=25)
+    parser.add_argument(
+        "--pallas_sepconv",
+        action="store_true",
+        help="profile with the fused Pallas sep-conv kernel "
+        "(NasNetConfig.use_pallas_sep_conv)",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -104,6 +110,7 @@ def main():
             num_cells=args.cells,
             num_conv_filters=args.filters,
             use_aux_head=False,
+            use_pallas_sep_conv=args.pallas_sepconv,
         ),
         seed=0,
     )

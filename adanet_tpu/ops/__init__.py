@@ -1,5 +1,6 @@
 """Custom Pallas TPU ops for the hot paths."""
 
 from adanet_tpu.ops.ensemble_kernels import fused_weighted_combine
+from adanet_tpu.ops.sepconv_kernels import fused_sep_conv, sep_conv_reference
 
-__all__ = ["fused_weighted_combine"]
+__all__ = ["fused_weighted_combine", "fused_sep_conv", "sep_conv_reference"]

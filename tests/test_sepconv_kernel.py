@@ -161,6 +161,42 @@ def test_nasnet_pallas_flag_preserves_params_and_outputs():
     )
 
 
+def test_remat_composes_with_pallas_flag():
+    """NasNetConfig(remat=True, use_pallas_sep_conv=True): the
+    custom-VJP op must compose with nn.remat's checkpointing — the
+    combination the TPU perf sweep runs (bench NASNET_REMAT=1 +
+    nasnet_pallas_sepconv config)."""
+    from adanet_tpu.models.nasnet import NasNetA, NasNetConfig
+
+    model = NasNetA(
+        NasNetConfig(
+            num_classes=10,
+            num_cells=3,
+            num_conv_filters=8,
+            use_aux_head=False,
+            drop_path_keep_prob=1.0,
+            dense_dropout_keep_prob=1.0,
+            compute_dtype=jnp.float32,
+            remat=True,
+            use_pallas_sep_conv=True,
+        )
+    )
+    images = jnp.asarray(
+        np.random.RandomState(1).randn(2, 16, 16, 3), jnp.float32
+    )
+    variables = model.init(jax.random.PRNGKey(0), images, training=False)
+
+    def loss(params):
+        logits, _, _ = model.apply(
+            {**variables, "params": params}, images, training=False
+        )
+        return jnp.sum(logits**2)
+
+    grads = jax.grad(loss)(variables["params"])
+    flat = jax.tree_util.tree_leaves(grads)
+    assert flat and all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+
 def test_oversized_example_falls_back_to_xla(monkeypatch):
     """One example bigger than the VMEM budget cannot tile on the batch
     axis (the kernel's only grid dim): the op must route to XLA instead

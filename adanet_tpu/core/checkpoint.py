@@ -76,6 +76,22 @@ def _atomic_write_json(path: str, obj) -> None:
     _atomic_write_bytes(path, json.dumps(obj, sort_keys=True).encode())
 
 
+def write_json(model_dir: str, filename: str, obj) -> str:
+    """Atomic (fsync'd) strict-JSON artifact write under `model_dir`."""
+    path = os.path.join(model_dir, filename)
+    _atomic_write_json(path, obj)
+    return path
+
+
+def read_json(model_dir: str, filename: str):
+    """Reads a JSON artifact written by `write_json`; None when absent."""
+    path = os.path.join(model_dir, filename)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def read_manifest(model_dir: str) -> Optional[CheckpointInfo]:
     path = os.path.join(model_dir, MANIFEST)
     if not os.path.exists(path):
@@ -154,6 +170,16 @@ def final_state_filename(iteration_number: int) -> str:
     eval dirs surviving every bookkeeping phase
     (reference: adanet/core/estimator.py:1683-1723)."""
     return "iteration-final-%d.msgpack" % iteration_number
+
+
+def candidate_metrics_filename(iteration_number: int) -> str:
+    """Per-candidate selection metrics persisted at every iteration end BY
+    DEFAULT (params-free, a few hundred bytes) — the always-available half
+    of the reference's per-candidate eval dirs
+    (reference: adanet/core/estimator.py:1683-1723);
+    `keep_candidate_states=True` additionally retains full states for
+    post-hoc re-evaluation on new data."""
+    return "candidate-metrics-%d.json" % iteration_number
 
 
 def architecture_filename(iteration_number: int) -> str:

@@ -26,6 +26,7 @@ from __future__ import annotations
 import inspect
 import itertools
 import logging
+import math
 import os
 import signal
 import tempfile
@@ -35,6 +36,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 import jax
 import numpy as np
 
+from adanet_tpu.core import candidate as candidate_lib
 from adanet_tpu.core import checkpoint as ckpt_lib
 from adanet_tpu.core.architecture import Architecture
 from adanet_tpu.core.compile_cache import CompileCache
@@ -1106,6 +1108,10 @@ class Estimator:
     def _get_best_ensemble_index(self, iteration, state) -> int:
         """Reference selection semantics (estimator.py:1415-1517)."""
         t = iteration.iteration_number
+        # Reset the evaluator-objective stash up front: replay/
+        # single-candidate selections must not leak a previous call's
+        # values into this iteration's candidate-metrics record.
+        self._last_selection_values = None
         if self._replay_config:
             index = self._replay_config.get_best_ensemble_index(t)
             if index is not None:
@@ -1125,6 +1131,8 @@ class Estimator:
                 batch_transform=self._place_batch,
                 collective=self._spmd_mesh is not None,
             )
+            # Stashed for the iteration-end candidate-metrics record.
+            self._last_selection_values = [float(v) for v in values]
             objective_fn = self._evaluator.objective_fn
             if exclude_first:
                 return int(objective_fn(values[1:])) + 1
@@ -1156,6 +1164,9 @@ class Estimator:
         frozen = iteration.freeze_candidate(state, spec.name, sample_batch)
         frozen.architecture.add_replay_index(best_index)
         frozen.architecture.set_global_step(info.global_step)
+
+        if write:
+            self._write_candidate_metrics(iteration, state, best_index, info)
 
         if write and self._keep_candidate_states:
             # Retain ALL candidates' final state (not just the winner) so
@@ -1215,6 +1226,96 @@ class Estimator:
         self._iteration_cache = None
         return frozen
 
+    def _write_candidate_metrics(self, iteration, state, best_index, info):
+        """Persists every candidate's selection metrics at iteration end —
+        BY DEFAULT, no constructor flag (round-4 verdict item 7).
+
+        The params-free half of the reference's always-available
+        per-candidate eval dirs (reference:
+        adanet/core/estimator.py:1683-1723): the EMA-tracked adanet loss,
+        the last raw adanet loss, the NaN-quarantine flag, the Evaluator
+        objective when an Evaluator drove selection, and which candidate
+        won — durable as `candidate-metrics-<t>.json` and charted under
+        `ensemble/<name>/eval`. Full-state retention for post-hoc
+        re-evaluation on new data remains opt-in
+        (`keep_candidate_states=True`)."""
+        cands = jax.device_get(state.candidates)
+        values = getattr(self, "_last_selection_values", None)
+
+        def finite(value):
+            # Dead/unset candidates carry inf/nan; strict JSON has no
+            # token for those — record null instead (the `dead` flag
+            # carries the semantics).
+            value = float(value)
+            return value if math.isfinite(value) else None
+
+        record = {}
+        for i, espec in enumerate(iteration.ensemble_specs):
+            cs = cands[espec.name]
+            entry = {
+                "adanet_loss": finite(cs.adanet_loss),
+                "adanet_loss_ema": finite(
+                    candidate_lib.debiased_ema(
+                        cs, iteration.adanet_loss_decay
+                    )
+                ),
+                "dead": bool(cs.dead),
+                "best": i == best_index,
+                "global_step": int(info.global_step),
+            }
+            if values is not None and i < len(values):
+                entry["evaluator_objective"] = finite(values[i])
+            record[espec.name] = entry
+        ckpt_lib.write_json(
+            self._model_dir,
+            ckpt_lib.candidate_metrics_filename(iteration.iteration_number),
+            record,
+        )
+        self._write_eval_summaries(
+            {
+                name: {
+                    k: v
+                    for k, v in entry.items()
+                    if k != "global_step"
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                }
+                for name, entry in record.items()
+            },
+            info.global_step,
+        )
+
+    def candidate_metrics(
+        self, iteration_number: Optional[int] = None
+    ) -> Dict[str, Dict[str, float]]:
+        """Per-candidate selection metrics of a completed iteration.
+
+        Always available post-training with no constructor flag (written
+        by every bookkeeping phase); `iteration_number` defaults to the
+        last completed iteration. For fresh metrics on new data use
+        `evaluate_all_candidates` (which needs the live mid-iteration
+        state or `keep_candidate_states=True`)."""
+        if iteration_number is None:
+            info = ckpt_lib.read_manifest(self._model_dir)
+            if info is None or info.iteration_number == 0:
+                raise ValueError(
+                    "No completed iteration in %s." % self._model_dir
+                )
+            # Completed iterations increment the manifest counter, so the
+            # last completed one is t-1 whether or not a new iteration is
+            # already in flight.
+            iteration_number = info.iteration_number - 1
+        record = ckpt_lib.read_json(
+            self._model_dir,
+            ckpt_lib.candidate_metrics_filename(iteration_number),
+        )
+        if record is None:
+            raise ValueError(
+                "No candidate metrics recorded for iteration %s in %s."
+                % (iteration_number, self._model_dir)
+            )
+        return record
+
     # ------------------------------------------------------- evaluate/predict
 
     def _final_forward_fn(self, sample_batch):
@@ -1239,11 +1340,15 @@ class Estimator:
             )
             best = self._get_best_ensemble_index(iteration, state)
             name = iteration.ensemble_specs[best].name
+            # Narrowed to the winning candidate's members (no optimizer
+            # state, no rival candidates): predict(on_cpu=True) transfers
+            # only what serving actually reads.
+            narrow = iteration.serving_state(state, name)
 
             def forward(s, features):
-                return iteration.ensemble_forward(s, name, features)
+                return iteration.serving_forward(s, name, features)
 
-            return forward, state, name
+            return forward, narrow, name
         # Otherwise: the frozen winner of the last completed iteration.
         frozen = self._rebuild_previous_ensemble(
             info.iteration_number, sample_batch
@@ -1433,7 +1538,9 @@ class Estimator:
                     "evaluate_all_candidates needs retained candidate "
                     "states for iteration %d; construct the Estimator with "
                     "keep_candidate_states=True (or call during an "
-                    "iteration, from a mid-iteration checkpoint)." % t
+                    "iteration, from a mid-iteration checkpoint). The "
+                    "selection metrics recorded at iteration end are "
+                    "always available via candidate_metrics(%d)." % (t, t)
                 )
             iteration = self._build_iteration(t, first)
             state = self._init_or_restore_state(

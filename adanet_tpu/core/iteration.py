@@ -798,6 +798,45 @@ class Iteration:
             state.ensembles[espec.name].params, member_outs
         )
 
+    def serving_state(self, state: IterationState, spec_name: str):
+        """Minimal pytree needed by `serving_forward` for one candidate.
+
+        `ensemble_forward` takes the full `IterationState` (every
+        candidate's parameters + optimizer state); serving one ensemble
+        only needs its member subnetworks' variables, the frozen member
+        variables, and its ensembler params — the narrow transfer matters
+        when predict() commits parameters to another backend
+        (estimator.predict(on_cpu=True))."""
+        espec = self._spec_by_name[spec_name]
+        new_refs = {ref for kind, ref in espec.members if kind == _NEW}
+        return {
+            "subnetworks": {
+                name: st.variables
+                for name, st in state.subnetworks.items()
+                if name in new_refs
+            },
+            "frozen": state.frozen,
+            "ensembler": state.ensembles[espec.name].params,
+        }
+
+    def serving_forward(self, narrow, spec_name: str, features):
+        """`ensemble_forward` over a `serving_state` pytree: computes only
+        the candidate's own member subnetworks, not every candidate's."""
+        espec = self._spec_by_name[spec_name]
+        features, _ = split_example_weights(
+            features, self.weight_key, require=False
+        )
+        sub_outs = {
+            s.name: s.module.apply(
+                narrow["subnetworks"][s.name], features, training=False
+            )
+            for s in self.subnetwork_specs
+            if s.name in narrow["subnetworks"]
+        }
+        frozen_outs = self.frozen_outputs(narrow["frozen"], features)
+        member_outs = self.member_outputs(espec, sub_outs, frozen_outs)
+        return espec.ensembler.build_ensemble(narrow["ensembler"], member_outs)
+
     def freeze_candidate(
         self, state: IterationState, spec_name: str, sample_batch
     ) -> FrozenEnsemble:

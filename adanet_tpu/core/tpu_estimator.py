@@ -23,6 +23,7 @@ behaviors that still matter:
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -67,6 +68,7 @@ class TPUEstimator(Estimator):
         # the CPU backend automatically — the reference's TPUEmbedding
         # inference fallback (adanet/core/tpu_estimator.py:180-227).
         self._embedding_tables_on_host = embedding_tables_on_host
+        self._warned_cpu_predict = False
 
     def predict(
         self,
@@ -86,9 +88,10 @@ class TPUEstimator(Estimator):
         TPUEmbedding inference fallback."""
         if on_cpu is None:
             on_cpu = self._embedding_tables_on_host
-            if on_cpu:
-                import logging
-
+            if on_cpu and not self._warned_cpu_predict:
+                # Once per estimator: long-lived serving processes call
+                # predict() per stream and would otherwise spam the log.
+                self._warned_cpu_predict = True
                 logging.getLogger(__name__).warning(
                     "TPU does not serve host-resident embedding tables; "
                     "predicting on CPU."

@@ -28,9 +28,12 @@ NasNetConfig.remat). The reference implementation is also the test oracle
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
+
+_LOG = logging.getLogger(__name__)
 
 try:  # Pallas is TPU/GPU-only at lowering time; import is safe everywhere.
     from jax.experimental import pallas as pl
@@ -142,6 +145,64 @@ def _pallas_forward(x, dw, pw, stride: int, interpret: bool):
     )(xp, dw, pw)
 
 
+# Per-shape Mosaic-lowering validation results for this process. The
+# kernel had only ever lowered in interpret mode until a TPU was live
+# (round-4 advice): a shape the real Mosaic pipeline rejects must degrade
+# to the XLA reference path with a warning, not crash the training run.
+_lowering_ok_cache = {}
+
+
+def _tpu_lowering_ok(x, dw, pw, stride: int) -> bool:
+    """AOT-compiles the kernel for the live TPU at exactly the caller's
+    shapes/dtypes (once per shape signature per process). True when TPU
+    is not this process's default backend: `platform_dependent`'s
+    default branch serves the other platforms, so there is nothing to
+    validate (and a CPU-targeted trace on a TPU host must not pay TPU
+    compiles). LOCAL devices only — under multi-host SPMD every process
+    validates against its own addressable chip, so the verdict (and
+    therefore the traced branch) is identical across processes."""
+    try:
+        if jax.default_backend() != "tpu":
+            return True
+        tpus = [d for d in jax.local_devices() if d.platform == "tpu"]
+    except Exception:  # backend init failure: nothing to lower for
+        return True
+    if not tpus:
+        return True
+    key = (
+        tuple(x.shape),
+        str(x.dtype),
+        tuple(dw.shape),
+        str(dw.dtype),
+        tuple(pw.shape),
+        str(pw.dtype),
+        stride,
+    )
+    ok = _lowering_ok_cache.get(key)
+    if ok is None:
+        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in (x, dw, pw)]
+        try:
+            with jax.default_device(tpus[0]):
+                jax.jit(
+                    functools.partial(
+                        _pallas_forward, stride=stride, interpret=False
+                    )
+                ).lower(*specs).compile()
+            ok = True
+        except Exception as exc:
+            _LOG.warning(
+                "Pallas fused sep-conv failed to lower for TPU at "
+                "signature %s (%s: %s); using the XLA reference path for "
+                "this shape.",
+                key,
+                type(exc).__name__,
+                exc,
+            )
+            ok = False
+        _lowering_ok_cache[key] = ok
+    return ok
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _fused_sep_conv_p(x, dw, pw, stride, interpret):
     return _pallas_forward(x, dw, pw, stride, interpret)
@@ -199,6 +260,8 @@ def fused_sep_conv(
         return sep_conv_reference(x, dw, pw, stride)
     if interpret:
         return _fused_sep_conv_p(x, dw, pw, stride, True)
+    if not _tpu_lowering_ok(x, dw, pw, stride):
+        return sep_conv_reference(x, dw, pw, stride)
     return jax.lax.platform_dependent(
         x,
         dw,

@@ -112,6 +112,40 @@ def test_bench_prints_one_json_line():
     assert "timing_caveat" not in result
 
 
+def test_probe_cache_marker(tmp_path, monkeypatch):
+    """Round-4 advice: a successful backend probe is cached in a TTL
+    marker so healthy-tunnel bench runs don't pay a full subprocess
+    backend init every time; failures are never cached."""
+    import time
+
+    import bench
+
+    marker = tmp_path / "probe_ok"
+    monkeypatch.setattr(bench, "_probe_cache_path", lambda: str(marker))
+
+    def boom(*args, **kwargs):
+        raise AssertionError("fresh marker must skip the subprocess probe")
+
+    marker.write_text("x")
+    monkeypatch.setattr(bench.subprocess, "run", boom)
+    assert bench._probe_backend() is True
+
+    # A stale marker really probes, and success refreshes the marker.
+    stale = time.time() - 10 * bench._PROBE_CACHE_TTL_SECS
+    os.utime(marker, (stale, stale))
+    ok = type("P", (), {"returncode": 0})()
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: ok)
+    assert bench._probe_backend() is True
+    assert time.time() - os.path.getmtime(marker) < 60
+
+    # Failure neither trusts nor writes the marker.
+    os.utime(marker, (stale, stale))
+    bad = type("P", (), {"returncode": 1})()
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: bad)
+    assert bench._probe_backend() is False
+    assert os.path.getmtime(marker) < time.time() - 60
+
+
 def test_bench_emits_structured_skip_when_backend_unavailable():
     """Round-3 verdict: a TPU outage must produce a machine-readable
     record with rc 0 (BENCH_r03 was a bare traceback), with the bench

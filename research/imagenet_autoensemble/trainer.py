@@ -67,9 +67,24 @@ flags.DEFINE_float("adanet_lambda", 0.0, "Complexity penalty lambda.")
 flags.DEFINE_bool(
     "learn_mixture_weights", False, "Train mixture weights."
 )
-flags.DEFINE_float("resnet_lr", 0.1, "ResNet SGD learning rate.")
 flags.DEFINE_float(
-    "efficientnet_lr", 0.016, "EfficientNet RMSProp learning rate."
+    "resnet_lr",
+    0.1,
+    "ResNet SGD learning rate. The published recipe value assumes a "
+    "global batch of 256; apply the linear scaling rule "
+    "(lr * batch/256) for other batch sizes.",
+)
+flags.DEFINE_float(
+    "efficientnet_lr",
+    0.016,
+    "EfficientNet RMSProp learning rate (per-256 batch; scale linearly).",
+)
+flags.DEFINE_float(
+    "clip_gradients",
+    5.0,
+    "Global-norm gradient clip for every candidate (0 disables) — the "
+    "same guard the improve_nas trainer applies; protects small-batch "
+    "runs from early divergence.",
 )
 flags.DEFINE_integer("seed", 42, "Random seed.")
 
@@ -101,6 +116,14 @@ def candidate_pool(num_classes: int, image_size: int):
     """
     small = image_size < 100
     pool = {}
+
+    def clipped(opt):
+        if FLAGS.clip_gradients > 0:
+            return optax.chain(
+                optax.clip_by_global_norm(FLAGS.clip_gradients), opt
+            )
+        return opt
+
     for name in [c.strip() for c in FLAGS.candidates.split(",") if c]:
         if name == "resnet50":
             pool["resnet%d" % FLAGS.resnet_depth] = AutoEnsembleSubestimator(
@@ -110,7 +133,7 @@ def candidate_pool(num_classes: int, image_size: int):
                     width=FLAGS.resnet_width,
                     small_inputs=small,
                 ),
-                optimizer=optax.sgd(FLAGS.resnet_lr, momentum=0.9),
+                optimizer=clipped(optax.sgd(FLAGS.resnet_lr, momentum=0.9)),
             )
         elif name == "efficientnet_b0":
             pool["efficientnet_%s" % FLAGS.efficientnet_variant] = (
@@ -120,8 +143,19 @@ def candidate_pool(num_classes: int, image_size: int):
                         variant=FLAGS.efficientnet_variant,
                         small_inputs=small,
                     ),
-                    optimizer=optax.rmsprop(
-                        FLAGS.efficientnet_lr, decay=0.9, momentum=0.9
+                    optimizer=clipped(
+                        # Published recipe epsilon (1e-3, not optax's 1e-8)
+                        # and a TF-style accumulator warm start: with the
+                        # second-moment estimate starting at 0 and a tiny
+                        # eps, the first preconditioned updates are ~1e4x
+                        # the gradient and no gradient clip can save them.
+                        optax.rmsprop(
+                            FLAGS.efficientnet_lr,
+                            decay=0.9,
+                            eps=1e-3,
+                            initial_scale=1.0,
+                            momentum=0.9,
+                        )
                     ),
                 )
             )

@@ -24,6 +24,10 @@ from typing import Any, Callable, Dict
 import jax
 import numpy as np
 
+# `jax.export` is a lazily-registered submodule: on pre-0.5 JAX the
+# attribute only exists after an explicit import.
+from jax import export as jax_export
+
 _LOG = logging.getLogger("adanet_tpu")
 
 SERVING_FILE = "serving.stablehlo"
@@ -67,7 +71,7 @@ def export_serving_program(
         # default_export_platform() canonicalizes the backend name for
         # jax.export (e.g. 'gpu' -> 'cuda'); raw jax.default_backend()
         # would be rejected on GPU hosts.
-        backend = jax.export.default_export_platform()
+        backend = jax_export.default_export_platform()
         if backend not in target_platforms:
             target_platforms.append(backend)
 
@@ -75,7 +79,7 @@ def export_serving_program(
         kwargs = (
             {"platforms": target_platforms} if multi_platform else {}
         )
-        return jax.export.export(jax.jit(predict_fn), **kwargs)(shapes)
+        return jax_export.export(jax.jit(predict_fn), **kwargs)(shapes)
 
     concrete = np.asarray(
         jax.tree_util.tree_leaves(sample_features)[0]
@@ -84,7 +88,7 @@ def export_serving_program(
     last_error = None
     attempts = []
     if polymorphic_batch:
-        (batch_sym,) = jax.export.symbolic_shape("batch")
+        (batch_sym,) = jax_export.symbolic_shape("batch")
         attempts.append((batch_sym, bool(target_platforms)))
         if target_platforms:
             attempts.append((batch_sym, False))
@@ -141,7 +145,7 @@ def load_serving_program(export_dir: str) -> Callable:
     Needs only jax — no generator, builders, or model code.
     """
     with open(os.path.join(export_dir, SERVING_FILE), "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = jax_export.deserialize(f.read())
     return exported.call
 
 

@@ -22,6 +22,7 @@ Key mappings:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -117,6 +118,49 @@ class EnsembleSpec:
 def _complexity_regularization(ensemble):
     """The ensemble's complexity penalty; 0 for parameterless ensembles."""
     return getattr(ensemble, "complexity_regularization", 0.0)
+
+
+class _ModuleHandle:
+    """Hashable-by-identity wrapper for a flax module.
+
+    Modules carrying dict attributes (e.g. multi-head logits dims) are
+    unhashable, so they cannot be jit static arguments directly. Identity
+    semantics are exactly right here: jit's cache entry holds the handle,
+    the handle holds the module, so the id stays valid for the cache's
+    lifetime.
+    """
+
+    __slots__ = ("module",)
+
+    def __init__(self, module):
+        self.module = module
+
+    def __hash__(self):
+        return id(self.module)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _ModuleHandle)
+            and other.module is self.module
+        )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _frozen_record_fields(handle, variables, features):
+    """Replicated record fields (complexity, shared) of one subnetwork.
+
+    Module-level with the flax module static (via `_ModuleHandle`) so
+    jit's cache keys on a stable function identity: freezing N members
+    across T iterations compiles once per module instead of once per
+    call (JL003). The flip side of caching on a permanent function is
+    retention: each distinct module object pins one cache entry (handle,
+    module, small executable) until jax's global cache evicts it. That
+    is one entry per freeze — bounded by the boosting iteration count —
+    not per-batch state; call `_frozen_record_fields.clear_cache()` if a
+    long-lived process ever needs to reclaim it.
+    """
+    out = handle.module.apply(variables, features, training=False)
+    return out.complexity, out.shared
 
 
 def split_example_weights(features, weight_key, require=True):
@@ -882,11 +926,9 @@ class Iteration:
                 # multi-host SPMD the batch-shaped outputs (last_layer,
                 # logits) span non-addressable devices and must not be
                 # device_get here.
-                out = jax.jit(
-                    lambda v, f, m=spec.module: (
-                        lambda s: (s.complexity, s.shared)
-                    )(m.apply(v, f, training=False))
-                )(device_variables, features)
+                out = _frozen_record_fields(
+                    _ModuleHandle(spec.module), device_variables, features
+                )
                 complexity, shared = jax.device_get(out)
                 frozen = FrozenSubnetwork(
                     iteration_number=self.iteration_number,

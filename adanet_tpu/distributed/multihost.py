@@ -122,6 +122,43 @@ def multihost_candidate_groups(
     return groups, owners
 
 
+def _broadcast_tree(payload, is_source: bool):
+    """`broadcast_one_to_all` with the whole pytree fused into ONE leaf.
+
+    multihost_utils broadcasts leaf-by-leaf: a multi-leaf payload becomes
+    several independent all-reduces in one XLA program, which the CPU
+    thunk executor is free to run concurrently — gloo then interleaves
+    their frames on the shared TCP pair and aborts the process
+    ("op.preamble.length <= op.nbytes"). Packing the tree into a single
+    uint8 blob issues exactly one collective per broadcast; it also turns
+    one DCN round per leaf into one per variable set, the same batching
+    the reference applies to its parameter-server fetches.
+    """
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree_util.tree_flatten(payload)
+    if not leaves:
+        return payload
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    blob = np.frombuffer(
+        b"".join(a.tobytes() for a in arrs), dtype=np.uint8
+    )
+    # The broadcast may return a widened integer dtype (psum accumulator);
+    # the byte VALUES are intact, so narrow back before byte-slicing.
+    out = np.asarray(
+        multihost_utils.broadcast_one_to_all(blob, is_source=is_source)
+    ).astype(np.uint8, copy=False)
+    rebuilt = []
+    offset = 0
+    for a in arrs:
+        chunk = out[offset : offset + a.nbytes]
+        rebuilt.append(
+            np.frombuffer(chunk.tobytes(), dtype=a.dtype).reshape(a.shape)
+        )
+        offset += a.nbytes
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
 def _fetch_replicated(tree):
     """Host copy of a pytree whose arrays are replicated over a (possibly
     non-fully-addressable) submesh this process participates in."""
@@ -329,15 +366,13 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
         src = self._owners[group_index][0]
         if self._process_count == 1:
             return payload_if_owner()
-        from jax.experimental import multihost_utils
-
         if self._owns(group_index):
             payload = payload_if_owner()
         else:
             payload = jax.tree_util.tree_map(
                 np.zeros_like, template_if_not()
             )
-        return multihost_utils.broadcast_one_to_all(
+        return _broadcast_tree(
             payload, is_source=(self._process_index == src)
         )
 
@@ -397,6 +432,29 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
 
     # ---------------------------------------------------------------- train
 
+    def _drain_if_unordered_collectives(self, group_index: int, *trees):
+        """Blocks on a multi-process group's in-flight program (CPU only).
+
+        TPU serializes a core's programs, so a dispatched step's psums
+        can never interleave with the next program's collectives and
+        async overlap across groups is safe. CPU gloo has no
+        cross-program ordering: an in-flight step's all-reduce frames
+        interleave with the next broadcast's on the shared TCP pair and
+        abort the transport ("op.preamble.length <= op.nbytes"). Only
+        groups whose submesh spans processes ever hold cross-process
+        collectives, so single-owner groups keep full async dispatch.
+        """
+        if (
+            self._process_count == 1
+            or len(self._owners[group_index]) <= 1
+            or jax.default_backend() != "cpu"
+        ):
+            return
+        for tree in trees:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if isinstance(leaf, jax.Array):
+                    leaf.block_until_ready()
+
     def train_step(self, state: IterationState, batch, extra_batches=None):
         """One candidate-parallel step; `batch` is this process's LOCAL
         batch. Owning processes dispatch their groups' programs; the
@@ -445,6 +503,7 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             self._last_local_losses[spec.name] = loss
             metrics["subnetwork_loss/%s" % spec.name] = loss
             metrics.update(extra)
+            self._drain_if_unordered_collectives(g, new_st, loss, extra)
 
         self._host_step += 1
         self._maybe_sync_members(new_subnetworks)
@@ -461,6 +520,9 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
                 ens_batch[1],
             )
             metrics.update(ens_metrics)
+            self._drain_if_unordered_collectives(
+                0, new_ens, new_cands, ens_metrics
+            )
         else:
             new_ens, new_cands = state.ensembles, state.candidates
 
@@ -520,6 +582,7 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             self._last_local_losses[spec.name] = loss
             metrics["subnetwork_loss/%s" % spec.name] = loss
             metrics.update(extra)
+            self._drain_if_unordered_collectives(g, new_st, loss, extra)
 
         self._host_step += k
         self._maybe_sync_members(new_subnetworks)
@@ -537,6 +600,9 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
                 ens_batch,
             )
             metrics.update(ens_metrics)
+            self._drain_if_unordered_collectives(
+                0, new_ens, new_cands, ens_metrics
+            )
         else:
             new_ens, new_cands = state.ensembles, state.candidates
 
@@ -591,15 +657,13 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
                     state.subnetworks[name]
                 )
                 continue
-            from jax.experimental import multihost_utils
-
             if self._owns(g):
                 payload = _fetch_replicated(state.subnetworks[name])
             else:
                 payload = jax.tree_util.tree_map(
                     np.zeros_like, self._host_template.subnetworks[name]
                 )
-            sub_states[name] = multihost_utils.broadcast_one_to_all(
+            sub_states[name] = _broadcast_tree(
                 payload, is_source=(self._process_index == src)
             )
 
@@ -607,8 +671,6 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             ens = _fetch_replicated(state.ensembles)
             cands = _fetch_replicated(state.candidates)
         else:
-            from jax.experimental import multihost_utils
-
             if self.owns_ensemble:
                 payload = (
                     _fetch_replicated(state.ensembles),
@@ -622,7 +684,7 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
                         self._host_template.candidates,
                     ),
                 )
-            ens, cands = multihost_utils.broadcast_one_to_all(
+            ens, cands = _broadcast_tree(
                 payload,
                 is_source=(self._process_index == self._owners[0][0]),
             )

@@ -21,6 +21,7 @@ Run (8 virtual devices):
 from __future__ import annotations
 
 import argparse
+import os
 import tempfile
 
 import jax
@@ -48,7 +49,17 @@ def main():
 
         if not xla_bridge._backends:
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", args.devices)
+            try:
+                jax.config.update("jax_num_cpu_devices", args.devices)
+            except AttributeError:
+                # Pre-0.5 JAX: no jax_num_cpu_devices option; the XLA
+                # flag is honored because the CPU backend has not
+                # initialized yet.
+                os.environ["XLA_FLAGS"] = os.environ.get(
+                    "XLA_FLAGS", ""
+                ) + " --xla_force_host_platform_device_count=%d" % (
+                    args.devices
+                )
     except Exception:
         pass
 

@@ -83,11 +83,11 @@ def input_fn():
 _SERVE_SNIPPET = """
 import json, sys
 import numpy as np
-import jax
+from jax import export as jax_export
 
 export_dir = sys.argv[1]
 with open(export_dir + "/serving.stablehlo", "rb") as f:
-    serve = jax.export.deserialize(f.read()).call
+    serve = jax_export.deserialize(f.read()).call
 for batch_size in (1, 7):
     out = serve({"x": np.random.RandomState(1).randn(batch_size, 4).astype(np.float32)})
     shapes = {k: list(np.asarray(v).shape) for k, v in out.items()

@@ -26,6 +26,7 @@ Run: python -m adanet_tpu.examples.tutorials.transfer_learning
 from __future__ import annotations
 
 import argparse
+import functools
 import tempfile
 
 import numpy as np
@@ -77,7 +78,9 @@ def pretrain(images, labels, steps: int, batch_size: int = 128):
     tx = optax.adam(1e-3)
     opt_state = tx.init(variables["params"])
 
-    @jax.jit
+    # Donate the carried state: without it the step holds input AND
+    # output param/opt buffers live at once, doubling peak HBM (JL004).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch_images, batch_labels):
         def loss_fn(p):
             logits = module.apply(

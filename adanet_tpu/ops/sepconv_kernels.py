@@ -48,6 +48,39 @@ except Exception:  # pragma: no cover
 _VMEM_BUDGET = 6 * 1024 * 1024
 
 
+@functools.lru_cache(maxsize=None)
+def _platform_dependent_prunes() -> bool:
+    """Whether `lax.platform_dependent` drops dead branches at lowering.
+
+    Pre-0.5 JAX lowers EVERY branch on every platform, so a TPU-only
+    Pallas branch poisons CPU lowering ("Only interpret mode is
+    supported on CPU backend"). Probed once with a trivial kernel; when
+    False, `fused_sep_conv` picks its path at trace time from the
+    default backend instead.
+    """
+    if not _HAS_PALLAS:
+        return False
+
+    def _kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def _tpu_branch(x):
+        return pl.pallas_call(
+            _kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )(x)
+
+    def _probe(x):
+        return jax.lax.platform_dependent(
+            x, tpu=_tpu_branch, default=lambda y: y
+        )
+
+    try:
+        jax.jit(_probe).lower(jnp.zeros((8,), jnp.float32))
+        return True
+    except Exception:
+        return False
+
+
 def _same_pads(size: int, kernel: int, stride: int):
     """TF/Flax 'SAME' padding (lo, hi) for one spatial dim."""
     out = -(-size // stride)
@@ -261,6 +294,10 @@ def fused_sep_conv(
     if interpret:
         return _fused_sep_conv_p(x, dw, pw, stride, True)
     if not _tpu_lowering_ok(x, dw, pw, stride):
+        return sep_conv_reference(x, dw, pw, stride)
+    if not _platform_dependent_prunes():
+        if jax.default_backend() == "tpu":
+            return _fused_sep_conv_p(x, dw, pw, stride, False)
         return sep_conv_reference(x, dw, pw, stride)
     return jax.lax.platform_dependent(
         x,

@@ -22,6 +22,42 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    # Pre-0.5 JAX ships shard_map under jax.experimental.
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if getattr(jax.lax, "pcast", None) is not None:
+    _SHARD_MAP_KWARGS = {}
+else:
+    # No pcast/varying type system (jax < 0.7): the replication checker
+    # cannot see through the ring's scan carry, so disable it. The kwarg
+    # is keyed on pcast availability, not on where shard_map lives —
+    # mid-range JAX has public jax.shard_map but still no pcast. The
+    # flag itself was renamed check_rep -> check_vma along the way.
+    import inspect as _inspect
+
+    _params = _inspect.signature(_shard_map).parameters
+    if "check_rep" in _params:
+        _SHARD_MAP_KWARGS = {"check_rep": False}
+    elif "check_vma" in _params:
+        _SHARD_MAP_KWARGS = {"check_vma": False}
+    else:
+        _SHARD_MAP_KWARGS = {}
+
+
+def _mark_varying(values, axis_name):
+    """`jax.lax.pcast(..., to="varying")` where available (jax >= 0.7).
+
+    Older JAX has no varying-axes types: values are returned unchanged
+    and the shard_map above runs with check_rep=False instead.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return values
+    return pcast(values, (axis_name,), to="varying")
+
 _NEG_INF = -1e30
 
 
@@ -61,14 +97,13 @@ def _ring_body(q, k, v, axis_name: str, causal: bool, seq_per_device: int):
 
     # Mark the accumulators as varying over the ring axis so the scan carry
     # types line up with the ppermute-rotated kv blocks.
-    acc, row_max, row_sum = jax.lax.pcast(
+    acc, row_max, row_sum = _mark_varying(
         (
             jnp.zeros((batch, sq, heads, d), jnp.float32),
             jnp.full((batch, sq, heads), _NEG_INF, jnp.float32),
             jnp.zeros((batch, sq, heads), jnp.float32),
         ),
-        (axis_name,),
-        to="varying",
+        axis_name,
     )
 
     q_pos = device_idx * seq_per_device + jnp.arange(sq)
@@ -148,11 +183,12 @@ def ring_attention(
         causal=causal,
         seq_per_device=seq_per_device,
     )
-    return jax.shard_map(
+    return _shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **_SHARD_MAP_KWARGS,
     )(q, k, v)
 
 

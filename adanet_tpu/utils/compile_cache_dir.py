@@ -1,0 +1,53 @@
+"""Topology-keyed persistent XLA compilation cache directories.
+
+Entries in jax's persistent compilation cache are only valid for the
+jax/jaxlib build and device topology that produced them; deserializing
+an executable written under a different one can crash the process
+outright (segfault observed when a cache directory was shared between
+1- and 8-device CPU runs across a jax upgrade). Keying the directory by
+version and topology makes stale entries unreachable instead of fatal —
+every (jax, jaxlib, backend, device-count) signature gets its own
+subdirectory under the shared base.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def versioned_cache_dir(base: str) -> str:
+    """`<base>/<jax>-<jaxlib>-<backend><ndevices>` for THIS process.
+
+    Calling this initializes jax's backend: call it only after platform
+    and device-count configuration (`jax_platforms`, `XLA_FLAGS` /
+    `jax_num_cpu_devices`) is final.
+    """
+    import jaxlib
+
+    tag = "%s-%s-%s%d" % (
+        jax.__version__,
+        jaxlib.__version__,
+        jax.default_backend(),
+        jax.device_count(),
+    )
+    return os.path.join(base, tag)
+
+
+def enable_persistent_cache(base: str, min_compile_secs: float = 1.0) -> str:
+    """Points jax's persistent compile cache at the versioned subdir.
+
+    Returns the directory actually configured. No-op on the cache-dir
+    setting if one is already configured (e.g. via
+    JAX_COMPILATION_CACHE_DIR at jax import time) — an explicit caller
+    choice wins.
+    """
+    if jax.config.jax_compilation_cache_dir is not None:
+        return jax.config.jax_compilation_cache_dir
+    path = versioned_cache_dir(base)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+    )
+    return path

@@ -464,14 +464,15 @@ def _emit_unavailable_record():
     except RuntimeError:
         pass
     # jax.config (env vars were read at import time; setting os.environ
-    # here would be a silent no-op).
-    if jax.config.jax_compilation_cache_dir is None:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "tests", ".jax_cache"),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # here would be a silent no-op). The cache dir is keyed by jax
+    # version + device topology so entries from other configurations
+    # (e.g. the 8-device test suite) can never be deserialized here.
+    from adanet_tpu.utils.compile_cache_dir import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tests", ".jax_cache")
+    )
     cpu_contract_ok = False
     contract_error = None
     WARMUP_STEPS, MEASURE_STEPS = 1, 2

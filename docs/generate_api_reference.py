@@ -13,6 +13,7 @@ CI keeps the output in sync via tests/test_docs.py.
 
 from __future__ import annotations
 
+import enum
 import importlib
 import inspect
 import os
@@ -63,6 +64,10 @@ def _public_members(module):
 
 
 def _signature(obj) -> str:
+    # Enum constructor signatures differ across CPython versions; pin a
+    # stable form so regenerated docs don't churn on the build Python.
+    if isinstance(obj, type) and issubclass(obj, enum.Enum):
+        return "(*values)"
     try:
         sig = str(inspect.signature(obj))
     except (TypeError, ValueError):
@@ -74,7 +79,15 @@ def _signature(obj) -> str:
 
 def _doc(obj) -> str:
     doc = inspect.getdoc(obj)
-    return doc.strip() if doc else "*Undocumented.*"
+    if not doc:
+        return "*Undocumented.*"
+    doc = doc.strip()
+    # Some environments ship docstrings with an unbalanced leading quote
+    # (e.g. flax's dataclass-generated `replace`); strip the artifact so
+    # regenerated docs don't churn on the build environment.
+    if doc.startswith('"') and doc.count('"') % 2 == 1:
+        doc = doc[1:]
+    return doc
 
 
 def _method_entries(cls):

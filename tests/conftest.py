@@ -14,15 +14,28 @@ import os
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Pre-0.5 JAX has no jax_num_cpu_devices option; the XLA flag is
+    # still honored because the CPU backend initializes lazily, after
+    # this module runs.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 # Persistent XLA compilation cache: NASNet-class modules are expensive to
-# compile on CPU; repeated test runs reuse compiled executables.
-_CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+# compile on CPU; repeated test runs reuse compiled executables. The dir
+# is keyed by (jax, jaxlib, backend, device count) — a flat shared dir
+# segfaulted the suite mid-run when it held executables serialized under
+# a different topology/jax build. Initializing the backend here (after
+# the platform/device config above) is safe: every test forces CPU.
+from adanet_tpu.utils.compile_cache_dir import enable_persistent_cache
+
+_CACHE_DIR = enable_persistent_cache(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 )
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def pytest_configure(config):

@@ -1,0 +1,22 @@
+"""JL001 fixture: Python side effects inside a jitted function."""
+
+import jax
+
+TRACE_LOG = []
+
+
+@jax.jit
+def step(x):
+    print("tracing step")  # expect: JL001
+    TRACE_LOG.append(x)  # expect: JL001
+    return x * 2
+
+
+@jax.jit
+def bump(x):
+    global _COUNT  # expect: JL001
+    _COUNT = 1
+    return x
+
+
+_COUNT = 0

@@ -1,0 +1,11 @@
+"""JL001 twin: per-step debug output and trace-local containers."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("step x = {}", x)
+    partials = []
+    partials.append(x * 2)
+    return partials[0]

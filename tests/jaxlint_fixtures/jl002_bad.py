@@ -1,0 +1,16 @@
+"""JL002 fixture: host-device syncs reachable from a jitted step."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def train_step(w, batch):
+    loss = compute_loss(w, batch)
+    return w - 0.1 * loss
+
+
+def compute_loss(w, batch):
+    scale = batch.mean().item()  # expect: JL002
+    host = np.asarray(w)  # expect: JL002
+    return host.sum() * scale

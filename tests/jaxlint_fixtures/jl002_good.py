@@ -1,0 +1,19 @@
+"""JL002 twin: the hot path stays on device; syncs live in host helpers."""
+
+import jax
+
+
+@jax.jit
+def train_step(w, batch):
+    loss = compute_loss(w, batch)
+    return w - 0.1 * loss
+
+
+def compute_loss(w, batch):
+    return ((w - batch) ** 2).mean()
+
+
+def log_metrics(metrics):
+    # One batched transfer on the logging boundary, not per step.
+    host = jax.device_get(metrics)
+    return {k: float(v) for k, v in host.items()}

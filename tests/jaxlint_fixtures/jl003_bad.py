@@ -1,0 +1,15 @@
+"""JL003 fixture: concretization and fresh-jit recompilation hazards."""
+
+import jax
+
+
+def run(fn, x):
+    return jax.jit(lambda v: fn(v) + 1)(x)  # expect: JL003
+
+
+@jax.jit
+def normalize(x, eps):
+    assert eps > 0  # expect: JL003
+    label = f"norm-{x}"  # expect: JL003
+    del label
+    return x / eps

@@ -1,0 +1,20 @@
+"""JL003 twin: hoisted jit with a stable identity; device-side checks."""
+
+import jax
+
+
+def _bump(v):
+    return v + 1
+
+
+_bump_jit = jax.jit(_bump)
+
+
+def run(x):
+    return _bump_jit(x)
+
+
+@jax.jit
+def normalize(x, eps):
+    jax.debug.print("normalizing {}", x)
+    return x / eps

@@ -1,0 +1,9 @@
+"""JL004 fixture: a state-carrying jitted step without buffer donation."""
+
+import jax
+
+
+@jax.jit
+def train_step(params, opt_state, batch):  # expect: JL004
+    grads = jax.grad(lambda p: (p * batch).sum())(params)
+    return params - grads, opt_state

@@ -1,0 +1,11 @@
+"""JL004 twin: the carried state is donated."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, opt_state, batch):
+    grads = jax.grad(lambda p: (p * batch).sum())(params)
+    return params - grads, opt_state

@@ -1,0 +1,16 @@
+"""JL005 fixture: PRNG keys consumed twice without a split."""
+
+import jax
+
+
+def init_all(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # expect: JL005
+    return a, b
+
+
+def sample_loop(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, (2,)))  # expect: JL005
+    return out

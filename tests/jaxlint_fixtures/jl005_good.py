@@ -1,0 +1,18 @@
+"""JL005 twin: every consumption draws from a freshly derived key."""
+
+import jax
+
+
+def init_all(key):
+    k_a, k_b = jax.random.split(key)
+    a = jax.random.normal(k_a, (4,))
+    b = jax.random.uniform(k_b, (4,))
+    return a, b
+
+
+def sample_loop(key, n):
+    out = []
+    for i in range(n):
+        step_key = jax.random.fold_in(key, i)
+        out.append(jax.random.normal(step_key, (2,)))
+    return out

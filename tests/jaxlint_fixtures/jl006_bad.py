@@ -1,0 +1,14 @@
+"""JL006 fixture: jnp in a host-only module.
+
+Linted under the virtual path ``adanet_tpu/core/checkpoint.py`` (the test
+passes the path explicitly) — JL006 keys on the module path, not the
+file contents.
+"""
+
+import jax.numpy as jnp  # expect: JL006
+import numpy as np
+
+
+def stack_batches(batches):
+    del np
+    return jnp.stack(batches)  # expect: JL006

@@ -1,0 +1,10 @@
+"""JL006 twin: host-only data path stays on numpy.
+
+Linted under the virtual path ``adanet_tpu/core/checkpoint.py``.
+"""
+
+import numpy as np
+
+
+def stack_batches(batches):
+    return np.stack(batches)

@@ -1,0 +1,16 @@
+"""JL007 fixture: unannotated pjit/shard_map entry points.
+
+Linted under the virtual path ``adanet_tpu/distributed/executor.py`` —
+JL007 only applies inside distributed/ and parallel/.
+"""
+
+from jax.experimental.pjit import pjit
+from jax.experimental.shard_map import shard_map
+
+
+def make_step(fn, mesh):
+    return pjit(fn)  # expect: JL007
+
+
+def make_mapped(body, mesh, spec):
+    return shard_map(body, mesh=mesh)  # expect: JL007

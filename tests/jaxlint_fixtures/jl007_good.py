@@ -1,0 +1,17 @@
+"""JL007 twin: the partitioning contract is written down.
+
+Linted under the virtual path ``adanet_tpu/distributed/executor.py``.
+"""
+
+from jax.experimental.pjit import pjit
+from jax.experimental.shard_map import shard_map
+
+
+def make_step(fn, mesh, spec):
+    return pjit(fn, in_shardings=(spec,), out_shardings=spec)
+
+
+def make_mapped(body, mesh, spec):
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec,), out_specs=spec
+    )

@@ -1,0 +1,17 @@
+"""JL008 fixture: Python control flow on traced values in jitted code."""
+
+import jax
+
+
+@jax.jit
+def clip_norm(x, limit):
+    if x > limit:  # expect: JL008
+        x = limit
+    return x
+
+
+@jax.jit
+def drain(x, floor):
+    while x > floor:  # expect: JL008
+        x = x * 0.5
+    return x

@@ -1,0 +1,17 @@
+"""JL008 twin: data-dependent control flow stays on device."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def clip_norm(x, limit):
+    return jnp.minimum(x, limit)
+
+
+@jax.jit
+def drain(x, floor):
+    return lax.while_loop(
+        lambda v: jnp.all(v > floor), lambda v: v * 0.5, x
+    )

@@ -9,6 +9,7 @@ ValueError on BOTH processes instead of deadlocking inside an XLA
 collective — the reference's cooperative-failure philosophy (SURVEY §5.3).
 """
 
+import os
 import sys
 
 import numpy as np
@@ -20,7 +21,21 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except AttributeError:
+        # Pre-0.5 JAX: the XLA flag works because the CPU backend
+        # has not initialized yet.
+        os.environ["XLA_FLAGS"] = os.environ.get(
+            "XLA_FLAGS", ""
+        ) + " --xla_force_host_platform_device_count=%d" % (1)
+    # Pre-0.5 JAX ships CPU cross-process collectives off by default
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"); newer JAX already defaults this to gloo.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass
     jax.distributed.initialize(
         coordinator_address="localhost:%s" % port,
         num_processes=2,

@@ -1,0 +1,1 @@
+"""Repository tooling: diagnostics, profiling, and the jaxlint analyzer."""

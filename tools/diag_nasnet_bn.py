@@ -10,6 +10,7 @@ BatchNorm momentum 0.9997, which needs ~10k steps for running statistics
 to converge — at 300 steps they are ~91% initialization.
 """
 
+import functools
 import os
 import sys
 
@@ -18,13 +19,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
+
+# NOTE: jax is already imported, so setting JAX_COMPILATION_CACHE_DIR in
+# os.environ here would be a silent no-op — the config must be updated
+# directly (and the dir is topology-keyed; see compile_cache_dir).
+from adanet_tpu.utils.compile_cache_dir import enable_persistent_cache
+
+enable_persistent_cache(
     os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "tests",
         ".jax_cache",
-    ),
+    )
 )
 
 import jax.numpy as jnp
@@ -76,7 +82,7 @@ def main():
         acc = jnp.mean(jnp.argmax(logits, -1) == batch_y)
         return loss, (new_state, acc)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, state, opt_state, bx, by):
         (loss, (new_state, acc)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -135,7 +141,9 @@ def main():
 
     # Re-estimate running stats with effective momentum 0.9 by replaying
     # 50 training batches through a BN-stat-update-only pass.
-    @jax.jit
+    # params is reused across calls here, so only the BN state carry is
+    # donated.
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def stat_update(params, state, bx):
         _, new_state = model.apply(
             {"params": params, **state},
@@ -145,7 +153,9 @@ def main():
         )
         return new_state
 
-    restate = jax.tree_util.tree_map(lambda x: x, state)
+    # Real copy, not an identity map: stat_update donates its state arg,
+    # and aliased leaves would invalidate `state` (still printed above).
+    restate = jax.tree_util.tree_map(jnp.copy, state)
     # crude: run many passes so 0.9997-momentum stats converge anyway
     for rep in range(4):
         for lo in range(0, n, batch):

@@ -1,0 +1,43 @@
+"""jaxlint: JAX/TPU-aware static analysis for this repository.
+
+Usage:
+    python -m tools.jaxlint adanet_tpu tools examples
+
+Rules (see docs/jaxlint.md for bad/good pairs):
+    JL001 Python side effects inside jitted functions (tracer leaks)
+    JL002 host-device syncs on jit-traced hot paths
+    JL003 tracer concretization / retrace hazards (f-string, assert, str)
+    JL004 step-like jitted functions missing donate_argnums
+    JL005 PRNG key reuse without split/fold_in
+    JL006 jnp in host-only data-path modules
+    JL007 pjit/shard_map entry points without explicit shardings
+    JL008 Python branches on traced values inside jitted code
+
+Suppress inline with `# jaxlint: disable=JL001(reason)` (same line or
+the line above), file-wide with `# jaxlint: disable-file=JL006(reason)`,
+or grandfather via `tools/jaxlint/baseline.json` (regenerate with
+`python -m tools.jaxlint --write-baseline <paths>`).
+"""
+
+from tools.jaxlint.engine import (
+    Finding,
+    default_baseline_path,
+    lint_source,
+    load_baseline,
+    main,
+    run_paths,
+    write_baseline,
+)
+from tools.jaxlint.rules import ALL_RULES, RULES_BY_ID
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "Finding",
+    "default_baseline_path",
+    "lint_source",
+    "load_baseline",
+    "main",
+    "run_paths",
+    "write_baseline",
+]

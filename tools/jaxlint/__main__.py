@@ -1,0 +1,8 @@
+"""`python -m tools.jaxlint` / `jaxlint` console-script entry point."""
+
+import sys
+
+from tools.jaxlint.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,365 @@
+"""jaxlint engine: findings, suppressions, baseline, file runner.
+
+A self-contained AST-level analyzer (stdlib only — it must never import
+the code under analysis, so it stays fast and side-effect free). Rules
+live in `tools.jaxlint.rules`; this module owns everything around them:
+
+- `Finding`: one diagnostic, keyed for baseline matching by
+  (path, rule, stripped source line) so line drift doesn't churn the
+  baseline file.
+- Inline suppressions: `# jaxlint: disable=JL001,JL005(reason)` on the
+  flagged line or the line directly above silences those rules there;
+  `# jaxlint: disable-file=JL006(reason)` anywhere in a file silences a
+  rule for the whole file.
+- Baseline: a checked-in JSON of grandfathered findings; the gate fails
+  only on findings NOT in the baseline (multiset semantics, so two
+  identical lines in one file need two entries).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import collections
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[^#]*)"
+)
+_RULE_ID_RE = re.compile(r"JL\d{3}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic at a source location."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    code: str = ""  # stripped source line, the baseline matching key
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.code)
+
+    def render(self) -> str:
+        return "%s:%d:%d: %s %s" % (
+            self.path,
+            self.line,
+            self.col,
+            self.rule,
+            self.message,
+        )
+
+
+class FileContext:
+    """Parsed source handed to every rule."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        return Finding(
+            path=self.path,
+            line=lineno,
+            col=getattr(node, "col_offset", 0),
+            rule=rule,
+            message=message,
+            code=self.line_at(lineno),
+        )
+
+
+def _suppressions(lines: Sequence[str]) -> Tuple[Dict[int, set], set]:
+    """Returns ({line -> suppressed rule ids}, file-wide rule ids)."""
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, text in enumerate(lines, start=1):
+        match = _SUPPRESS_RE.search(text)
+        if not match:
+            continue
+        # Drop parenthesized reasons before extracting rule ids, so a
+        # reason that mentions another rule ("JL004(mirrors the JL001
+        # fix)") does not silently suppress it too.
+        rule_list = re.sub(r"\([^()]*\)", "", match.group("rules"))
+        rules = set(_RULE_ID_RE.findall(rule_list))
+        if not rules:
+            continue
+        if match.group("scope"):
+            file_wide |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, file_wide
+
+
+def _is_suppressed(
+    finding: Finding, per_line: Dict[int, set], file_wide: set
+) -> bool:
+    if finding.rule in file_wide:
+        return True
+    for lineno in (finding.line, finding.line - 1):
+        if finding.rule in per_line.get(lineno, set()):
+            return True
+    return False
+
+
+def lint_source(
+    path: str, source: str, rules: Sequence
+) -> Tuple[List[Finding], List[Finding]]:
+    """Lints one file's source; returns (active, suppressed) findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        finding = Finding(
+            path=path,
+            line=exc.lineno or 1,
+            col=exc.offset or 0,
+            rule="JL000",
+            message="file does not parse: %s" % exc.msg,
+        )
+        return [finding], []
+    ctx = FileContext(path, source, tree)
+    per_line, file_wide = _suppressions(ctx.lines)
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in rules:
+        for finding in rule.check(ctx):
+            if _is_suppressed(finding, per_line, file_wide):
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return active, suppressed
+
+
+def iter_python_files(paths: Iterable[str]) -> Tuple[List[str], List[str]]:
+    """Expands files/directories into .py files; returns (files, missing)."""
+    files: List[str] = []
+    missing: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files.append(path)
+        elif os.path.isdir(path):
+            for root, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d
+                    for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        files.append(os.path.join(root, name))
+        else:
+            missing.append(path)
+    return files, missing
+
+
+def run_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence] = None,
+    baseline: Optional[Dict] = None,
+) -> Dict:
+    """Lints `paths`; returns a result dict (see keys below).
+
+    Result keys: `findings` (non-baselined, non-suppressed — these fail
+    the gate), `baselined`, `suppressed`, `missing_paths`,
+    `unused_baseline` (stale entries worth pruning), `files` (count).
+    """
+    if rules is None:
+        from tools.jaxlint.rules import ALL_RULES
+
+        rules = ALL_RULES
+    files, missing = iter_python_files(paths)
+    all_active: List[Finding] = []
+    all_suppressed: List[Finding] = []
+    for filename in files:
+        with open(filename, "r", encoding="utf-8") as f:
+            source = f.read()
+        active, suppressed = lint_source(
+            _normalize(filename), source, rules
+        )
+        all_active.extend(active)
+        all_suppressed.extend(suppressed)
+
+    budget = collections.Counter(
+        (e["path"], e["rule"], e["code"]) for e in (baseline or {}).get(
+            "entries", []
+        )
+    )
+    new: List[Finding] = []
+    grandfathered: List[Finding] = []
+    for finding in all_active:
+        key = finding.baseline_key()
+        if budget[key] > 0:
+            budget[key] -= 1
+            grandfathered.append(finding)
+        else:
+            new.append(finding)
+    unused = [
+        {"path": path, "rule": rule, "code": code, "count": count}
+        for (path, rule, code), count in sorted(budget.items())
+        if count > 0
+    ]
+    return {
+        "findings": new,
+        "baselined": grandfathered,
+        "suppressed": all_suppressed,
+        "missing_paths": missing,
+        "unused_baseline": unused,
+        "files": len(files),
+    }
+
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _normalize(path: str) -> str:
+    # Key findings relative to the repo root, not the invocation CWD, so
+    # baseline entries match no matter where `jaxlint` is run from.
+    abs_path = os.path.abspath(path)
+    if abs_path == _REPO_ROOT or abs_path.startswith(_REPO_ROOT + os.sep):
+        abs_path = os.path.relpath(abs_path, _REPO_ROOT)
+    return abs_path.replace(os.sep, "/")
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str) -> Optional[Dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> Dict:
+    data = {
+        "version": 1,
+        "comment": (
+            "Grandfathered jaxlint findings. Entries match by "
+            "(path, rule, stripped source line); remove entries as the "
+            "code they cover is fixed."
+        ),
+        "entries": [
+            {"path": f.path, "rule": f.rule, "code": f.code}
+            for f in sorted(findings, key=lambda f: (f.path, f.line))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return data
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="JAX/TPU-aware static analysis (tools/jaxlint).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories (required unless --list-rules)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=default_baseline_path(),
+        help="baseline JSON of grandfathered findings",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    from tools.jaxlint.rules import ALL_RULES
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print("%s  %s" % (rule.rule_id, rule.summary))
+        return 0
+    if not args.paths:
+        parser.error("the following arguments are required: paths")
+
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline = load_baseline(args.baseline)
+    result = run_paths(args.paths, rules=ALL_RULES, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result["findings"])
+        print(
+            "jaxlint: wrote %d baseline entries to %s"
+            % (len(result["findings"]), args.baseline)
+        )
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        dataclasses.asdict(f) for f in result["findings"]
+                    ],
+                    "baselined": len(result["baselined"]),
+                    "suppressed": len(result["suppressed"]),
+                    "files": result["files"],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for finding in result["findings"]:
+            print(finding.render())
+        for path in result["missing_paths"]:
+            print(
+                "jaxlint: warning: path %r does not exist (skipped)" % path,
+                file=sys.stderr,
+            )
+        for entry in result["unused_baseline"]:
+            print(
+                "jaxlint: warning: stale baseline entry %s %s %r"
+                % (entry["rule"], entry["path"], entry["code"]),
+                file=sys.stderr,
+            )
+        print(
+            "jaxlint: %d file(s), %d finding(s), %d baselined, "
+            "%d suppressed"
+            % (
+                result["files"],
+                len(result["findings"]),
+                len(result["baselined"]),
+                len(result["suppressed"]),
+            ),
+            file=sys.stderr,
+        )
+    return 1 if result["findings"] else 0

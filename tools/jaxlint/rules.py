@@ -1,0 +1,850 @@
+"""The jaxlint rule set: 8 JAX/TPU-specific AST checks.
+
+Every rule encodes an invariant this codebase has paid for at least once
+(see docs/jaxlint.md for the bad/good pair and the failure each rule
+prevents). The analysis is intentionally file-local and approximate —
+"jitted" means a `jax.jit`/`pjit` decorator, a `jax.jit(fn)` wrap, or a
+function handed to `CachedStep` (this repo's signature-cached jit
+wrapper); the call graph used for hot-path reachability is intra-file.
+False positives are expected to be rare and are handled with inline
+`# jaxlint: disable=JLxxx(reason)` suppressions or the baseline file,
+never by weakening the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.engine import FileContext, Finding
+
+# --------------------------------------------------------------- helpers
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Attribute/Name chains, else None."""
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return "%s.%s" % (base, node.attr) if base else None
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for an expression naming a jit-family transform."""
+    name = dotted_name(node)
+    if not name:
+        return False
+    return name.split(".")[-1] in {"jit", "pjit"}
+
+
+def jit_decorator_kwargs(dec: ast.AST) -> Optional[Set[str]]:
+    """If `dec` is a jit-family decorator, the keyword names it passes.
+
+    Handles `@jax.jit`, `@jit`, `@pjit`, `@jax.jit(...)`, and
+    `@functools.partial(jax.jit, ...)`. Returns None for non-jit
+    decorators.
+    """
+    if _is_jit_expr(dec):
+        return set()
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return {kw.arg for kw in dec.keywords if kw.arg}
+        func = dotted_name(dec.func)
+        if (
+            func
+            and func.split(".")[-1] == "partial"
+            and dec.args
+            and _is_jit_expr(dec.args[0])
+        ):
+            return {kw.arg for kw in dec.keywords if kw.arg}
+    return None
+
+
+def iter_functions(
+    tree: ast.Module,
+) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def jit_functions(ctx: FileContext) -> List[ast.FunctionDef]:
+    """Functions traced by jit: decorated, jit-wrapped, or CachedStep'd.
+
+    Wrap forms recognized anywhere in the file:
+      `anything = jax.jit(fn, ...)` / `jax.jit(self._f, ...)` and
+      `CachedStep(fn_or_method, ...)` — the repo's cached-jit wrapper.
+    """
+    by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for func in iter_functions(ctx.tree):
+        by_name.setdefault(func.name, []).append(func)
+
+    jitted: List[ast.FunctionDef] = []
+    seen: Set[int] = set()
+
+    def add(func: ast.FunctionDef) -> None:
+        if id(func) not in seen:
+            seen.add(id(func))
+            jitted.append(func)
+
+    for func in iter_functions(ctx.tree):
+        if any(
+            jit_decorator_kwargs(dec) is not None
+            for dec in func.decorator_list
+        ):
+            add(func)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func_name = dotted_name(node.func)
+        if not func_name:
+            continue
+        last = func_name.split(".")[-1]
+        if last not in {"jit", "pjit", "CachedStep"}:
+            continue
+        target = node.args[0]
+        target_name = dotted_name(target)
+        if not target_name:
+            continue
+        # `self._train_step_impl` -> `_train_step_impl`
+        for func in by_name.get(target_name.split(".")[-1], []):
+            add(func)
+    return jitted
+
+
+def param_names(func: ast.FunctionDef) -> List[str]:
+    args = func.args
+    names = [
+        a.arg
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+    ]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def assigned_names(node: ast.AST) -> Set[str]:
+    """Names bound by assignments/loops/withs anywhere under `node`."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(
+            sub.ctx, (ast.Store, ast.Del)
+        ):
+            out.add(sub.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(sub.name)
+    return out
+
+
+def local_call_graph(ctx: FileContext) -> Dict[str, Set[str]]:
+    """name -> names it calls (plain `f(...)` and `self.f(...)`)."""
+    graph: Dict[str, Set[str]] = {}
+    for func in iter_functions(ctx.tree):
+        callees: Set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name:
+                    callees.add(name.split(".")[-1])
+        graph.setdefault(func.name, set()).update(callees)
+    return graph
+
+
+def reachable_from(
+    roots: Sequence[str], graph: Dict[str, Set[str]]
+) -> Set[str]:
+    seen: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        stack.extend(graph.get(name, ()))
+    return seen
+
+
+class Rule:
+    rule_id = "JL000"
+    summary = ""
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- JL001
+
+
+class TracerLeakRule(Rule):
+    """Python side effects inside jitted functions.
+
+    A jitted function runs ONCE per compilation as a trace; `print`,
+    `global`/`nonlocal` writes, and mutations of containers that outlive
+    the trace (closure/module state) either leak tracers out of the trace
+    or silently run at trace time only — per compile, not per step.
+    """
+
+    rule_id = "JL001"
+    summary = "Python side effect inside a jitted function"
+
+    _MUTATORS = {
+        "append",
+        "extend",
+        "insert",
+        "update",
+        "setdefault",
+        "add",
+        "remove",
+        "discard",
+        "clear",
+        "pop",
+        "popitem",
+    }
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        for func in jit_functions(ctx):
+            local = assigned_names(func) | set(param_names(func))
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    if name == "print":
+                        findings.append(
+                            ctx.finding(
+                                node,
+                                self.rule_id,
+                                "print() inside jitted %r runs at trace "
+                                "time only (use jax.debug.print for "
+                                "per-step output)" % func.name,
+                            )
+                        )
+                elif (
+                    # Bare-statement mutator calls only: pure-functional
+                    # APIs spelled the same way (optax's `tx.update(...)`)
+                    # always bind the result, container mutations discard
+                    # it.
+                    isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in self._MUTATORS
+                    and isinstance(node.value.func.value, ast.Name)
+                    and node.value.func.value.id not in local
+                ):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "mutating enclosing-scope container %r "
+                            "inside jitted %r leaks tracers (runs at "
+                            "trace time, once per compile)"
+                            % (node.value.func.value.id, func.name),
+                        )
+                    )
+                elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "%s write inside jitted %r is a trace-time "
+                            "side effect"
+                            % (type(node).__name__.lower(), func.name),
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------- JL002
+
+
+class HostSyncRule(Rule):
+    """Host-device syncs in jit-traced code or functions it calls.
+
+    `.item()`, `float()`, `np.asarray`, `jax.device_get`,
+    `block_until_ready` inside traced code either fail on tracers or
+    force a blocking device round-trip on the hot path — paid once per
+    candidate per boosting iteration in this codebase.
+    """
+
+    rule_id = "JL002"
+    summary = "host-device sync on a jit-traced hot path"
+
+    _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+    _SYNC_CALLS = {
+        "np.asarray",
+        "np.array",
+        "numpy.asarray",
+        "numpy.array",
+        "onp.asarray",
+        "onp.array",
+        "jax.device_get",
+        "device_get",
+    }
+    _CASTS = {"float", "int", "bool"}
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        jitted = jit_functions(ctx)
+        if not jitted:
+            return []
+        graph = local_call_graph(ctx)
+        jit_names = {f.name for f in jitted}
+        hot = reachable_from(sorted(jit_names), graph)
+        hot_funcs = [
+            f
+            for f in iter_functions(ctx.tree)
+            if f.name in hot and not self._host_helper(f)
+        ]
+        findings = []
+        for func in hot_funcs:
+            in_jit = func.name in jit_names
+            params = set(param_names(func))
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._SYNC_ATTRS
+                ):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            ".%s() in %r (reached from a jitted step) "
+                            "blocks on the device"
+                            % (node.func.attr, func.name),
+                        )
+                    )
+                elif name in self._SYNC_CALLS:
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "%s in %r (reached from a jitted step) pulls "
+                            "the value to the host" % (name, func.name),
+                        )
+                    )
+                elif (
+                    in_jit
+                    and name in self._CASTS
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "%s(%s) inside jitted %r concretizes a tracer"
+                            % (name, node.args[0].id, func.name),
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _host_helper(func: ast.FunctionDef) -> bool:
+        # Logging/summary/checkpoint helpers are host-side by design even
+        # when a jitted method's class also defines them.
+        # "log" needs word-ish boundaries: a bare substring match would
+        # classify logits helpers (eval_logits, get_logits) as host-side.
+        return bool(
+            re.search(
+                r"summar|(?:^|_)log(?:$|_|ging)|checkpoint|save|restore|host",
+                func.name,
+            )
+        )
+
+
+# ---------------------------------------------------------------- JL003
+
+
+class RecompileHazardRule(Rule):
+    """Trace-time concretization of tracers inside jitted functions.
+
+    f-strings/`str()`/`assert` on traced arguments raise
+    ConcretizationTypeError, or — when the value happens to be static —
+    silently bake it into the compiled program and retrace per value.
+    """
+
+    rule_id = "JL003"
+    summary = "tracer concretization / retrace hazard in jitted code"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        # jit(lambda ...) built at call time: a fresh function identity
+        # per call misses jax's jit cache, so every invocation re-pays
+        # tracing AND XLA compilation — per candidate per iteration here.
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _is_jit_expr(node.func)
+                and node.args
+                and isinstance(node.args[0], ast.Lambda)
+            ):
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "jit(lambda ...) constructs a fresh function "
+                        "identity per call: jax's jit cache never hits, "
+                        "so this recompiles on every invocation (hoist "
+                        "the jitted function, or route it through "
+                        "CompileCache/CachedStep)",
+                    )
+                )
+        for func in jit_functions(ctx):
+            params = set(param_names(func))
+            for node in ast.walk(func):
+                if isinstance(node, ast.JoinedStr):
+                    used = self._param_refs(node, params)
+                    if used:
+                        findings.append(
+                            ctx.finding(
+                                node,
+                                self.rule_id,
+                                "f-string on traced argument(s) %s inside "
+                                "jitted %r concretizes at trace time (use "
+                                "jax.debug.print)"
+                                % (sorted(used), func.name),
+                            )
+                        )
+                elif isinstance(node, ast.Assert):
+                    used = self._param_refs(node.test, params)
+                    if used:
+                        findings.append(
+                            ctx.finding(
+                                node,
+                                self.rule_id,
+                                "assert on traced argument(s) %s inside "
+                                "jitted %r (use checkify or move the "
+                                "check to the host)"
+                                % (sorted(used), func.name),
+                            )
+                        )
+                elif (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) == "str"
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "str(%s) inside jitted %r concretizes a "
+                            "tracer" % (node.args[0].id, func.name),
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _param_refs(node: ast.AST, params: Set[str]) -> Set[str]:
+        return {
+            sub.id
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Name)
+            and isinstance(sub.ctx, ast.Load)
+            and sub.id in params
+        }
+
+
+# ---------------------------------------------------------------- JL004
+
+
+class MissingDonationRule(Rule):
+    """Step-like jitted functions carrying state without buffer donation.
+
+    A train/update step that takes the full train state and returns the
+    new one doubles peak HBM unless the input buffers are donated
+    (`donate_argnums`/`donate_argnames`) — on TPU that halves the largest
+    trainable model.
+    """
+
+    rule_id = "JL004"
+    summary = "jitted step function without donate_argnums"
+
+    _STEP_NAME = re.compile(r"step|update|train")
+    _SKIP_NAME = re.compile(
+        r"eval|metric|predict|loss|logit|forward|apply|init|lower"
+    )
+    _STATE_PARAMS = {
+        "state",
+        "params",
+        "variables",
+        "opt_state",
+        "carry",
+        "train_state",
+        "model_state",
+    }
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        for func in iter_functions(ctx.tree):
+            kwargs: Optional[Set[str]] = None
+            for dec in func.decorator_list:
+                info = jit_decorator_kwargs(dec)
+                if info is not None:
+                    kwargs = info
+                    break
+            if kwargs is None:
+                continue
+            if not self._STEP_NAME.search(func.name):
+                continue
+            if self._SKIP_NAME.search(func.name):
+                continue
+            state_args = [
+                n
+                for n in param_names(func)
+                if n in self._STATE_PARAMS
+                or n.endswith("_state")
+                or n.endswith("_params")
+            ]
+            if not state_args:
+                continue
+            if kwargs & {"donate_argnums", "donate_argnames"}:
+                continue
+            findings.append(
+                ctx.finding(
+                    func,
+                    self.rule_id,
+                    "jitted step %r carries state (%s) without "
+                    "donate_argnums: peak memory holds input AND output "
+                    "buffers" % (func.name, ", ".join(state_args)),
+                )
+            )
+        return findings
+
+
+# ---------------------------------------------------------------- JL005
+
+
+class KeyReuseRule(Rule):
+    """A PRNG key consumed by two `jax.random.*` draws with no split.
+
+    Reusing a key makes two 'independent' draws identical — in this
+    codebase that silently correlates candidate initializations and
+    corrupts the ensemble search. Every consumption must be preceded by
+    `split`/`fold_in` deriving a fresh key.
+    """
+
+    rule_id = "JL005"
+    summary = "PRNG key reused by two jax.random draws without a split"
+
+    _DERIVE = {"split", "fold_in", "PRNGKey", "key", "clone", "wrap_key_data"}
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        for func in iter_functions(ctx.tree):
+            findings.extend(self._check_scope(ctx, func))
+        return findings
+
+    # -- helpers
+
+    def _is_random_consumer(self, call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        if not name:
+            return False
+        parts = name.split(".")
+        if parts[-1] in self._DERIVE:
+            return False
+        # jax.random.normal / random.bernoulli / jrandom.uniform ...
+        return "random" in parts[:-1]
+
+    def _consumed_key(self, call: ast.Call) -> Optional[str]:
+        if not self._is_random_consumer(call) or not call.args:
+            return None
+        first = call.args[0]
+        return first.id if isinstance(first, ast.Name) else None
+
+    def _check_scope(
+        self, ctx: FileContext, func: ast.FunctionDef
+    ) -> List[Finding]:
+        """Two passes over one function scope (nested defs excluded).
+
+        Sequential pass: events (draw / rebind) per key name, ordered by
+        line; a second draw with no rebind in between is a reuse. This is
+        control-flow-insensitive — an if/else drawing from the same key
+        in both arms is a (rare) false positive for the suppression
+        mechanism.
+
+        Loop pass: a draw inside a for/while from a key that the loop
+        never rebinds (and that is not the loop variable) repeats the
+        exact same bits every iteration.
+        """
+        findings: List[Finding] = []
+        draws: List[Tuple[int, str, ast.Call]] = []
+        stores: Dict[str, List[int]] = {}
+        for node in _scope_walk(func):
+            if isinstance(node, ast.Call):
+                key = self._consumed_key(node)
+                if key is not None:
+                    draws.append((node.lineno, key, node))
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                stores.setdefault(node.id, []).append(node.lineno)
+
+        flagged: Set[int] = set()
+        last_draw: Dict[str, int] = {}
+        for lineno, key, node in sorted(draws, key=lambda d: d[0]):
+            prev = last_draw.get(key)
+            if prev is not None and not any(
+                prev <= s <= lineno for s in stores.get(key, [])
+            ):
+                flagged.add(id(node))
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "PRNG key %r consumed again (first drawn from at "
+                        "line %d) without an intervening split/fold_in: "
+                        "both draws return identical bits" % (key, prev),
+                    )
+                )
+            last_draw[key] = lineno
+
+        for loop in _scope_walk(func):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            rebound = _stored_names(loop)
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in flagged:
+                    continue
+                key = self._consumed_key(node)
+                if key is not None and key not in rebound:
+                    flagged.add(id(node))
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "PRNG key %r drawn from inside a loop but "
+                            "never split per iteration: every pass "
+                            "reuses the same bits (fold_in the loop "
+                            "index)" % key,
+                        )
+                    )
+        return findings
+
+
+def _scope_walk(func: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Walks a function body without descending into nested defs."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _stored_names(node: ast.AST) -> Set[str]:
+    return {
+        sub.id
+        for sub in ast.walk(node)
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)
+    }
+
+
+# ---------------------------------------------------------------- JL006
+
+
+class HostModuleJnpRule(Rule):
+    """`jnp` in host-only data-path modules.
+
+    Checkpointing, report stores, summaries, batching, prefetch, and
+    coordination run on the host between device steps; `jnp` there
+    allocates device buffers and compiles kernels for work numpy does in
+    nanoseconds — and silently moves the data path onto the accelerator.
+    """
+
+    rule_id = "JL006"
+    summary = "jnp used in a host-only data-path module"
+
+    HOST_ONLY = (
+        "utils/batches.py",
+        "utils/prefetch.py",
+        "core/checkpoint.py",
+        "core/report_accessor.py",
+        "core/summary.py",
+        "core/timer.py",
+        "distributed/coordination.py",
+        "replay/__init__.py",
+    )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not any(path.endswith(suffix) for suffix in self.HOST_ONLY):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                module = getattr(node, "module", None) or ""
+                names = [a.name for a in node.names]
+                if "jax.numpy" in names or module == "jax.numpy" or (
+                    module == "jax" and "numpy" in names
+                ):
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "host-only module imports jax.numpy; use "
+                            "numpy — this code runs between device "
+                            "steps, not on them",
+                        )
+                    )
+            elif (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jnp"
+            ):
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "jnp.%s in host-only module (use np.%s)"
+                        % (node.attr, node.attr),
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------- JL007
+
+
+class UnshardedEntryRule(Rule):
+    """`pjit`/`shard_map` entry points without explicit shardings.
+
+    In `distributed/` and `parallel/`, an unannotated entry point leaves
+    layout to GSPMD inference, which changes silently across JAX versions
+    and mesh shapes; partitioning contracts at process boundaries must be
+    written down.
+    """
+
+    rule_id = "JL007"
+    summary = "pjit/shard_map entry point without in/out shardings"
+
+    _DIRS = ("/distributed/", "/parallel/")
+    _REQUIRED = {
+        "pjit": ({"in_shardings", "in_axis_resources"},
+                 {"out_shardings", "out_axis_resources"}),
+        "shard_map": ({"in_specs"}, {"out_specs"}),
+        "smap": ({"in_specs"}, {"out_specs"}),
+    }
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        path = "/" + ctx.path.replace("\\", "/")
+        if not any(d in path for d in self._DIRS):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            last = name.split(".")[-1]
+            if last not in self._REQUIRED:
+                continue
+            given = {kw.arg for kw in node.keywords if kw.arg}
+            in_ok, out_ok = self._REQUIRED[last]
+            missing = []
+            if not (given & in_ok):
+                missing.append(sorted(in_ok)[0])
+            if not (given & out_ok):
+                missing.append(sorted(out_ok)[0])
+            if missing:
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "%s(...) without explicit %s: partitioning is "
+                        "left to GSPMD inference — annotate the entry "
+                        "point" % (last, " and ".join(missing)),
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------- JL008
+
+
+class TracerBranchRule(Rule):
+    """Python `if`/`while` on traced values inside jitted functions.
+
+    Branching on a tracer raises TracerBoolConversionError — or, with a
+    static argument, silently compiles one branch per value. Data-
+    dependent control flow belongs in `lax.cond`/`lax.select`/`lax.scan`.
+    """
+
+    rule_id = "JL008"
+    summary = "Python branch on a traced value inside jitted code"
+
+    _ORDER_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        for func in jit_functions(ctx):
+            params = set(param_names(func))
+            for node in ast.walk(func):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                hit = self._traced_test(node.test, params)
+                if hit:
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "Python %s on traced argument %r inside "
+                            "jitted %r: use lax.cond/lax.select (or mark "
+                            "the argument static)"
+                            % (
+                                "while" if isinstance(node, ast.While)
+                                else "if",
+                                hit,
+                                func.name,
+                            ),
+                        )
+                    )
+        return findings
+
+    def _traced_test(
+        self, test: ast.AST, params: Set[str]
+    ) -> Optional[str]:
+        if isinstance(test, ast.BoolOp):
+            for value in test.values:
+                hit = self._traced_test(value, params)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.Compare):
+            if not all(
+                isinstance(op, self._ORDER_OPS) for op in test.ops
+            ):
+                return None  # ==/in/is compare static config, not tracers
+            for side in [test.left] + list(test.comparators):
+                if isinstance(side, ast.Name) and side.id in params:
+                    return side.id
+        return None
+
+
+ALL_RULES: List[Rule] = [
+    TracerLeakRule(),
+    HostSyncRule(),
+    RecompileHazardRule(),
+    MissingDonationRule(),
+    KeyReuseRule(),
+    HostModuleJnpRule(),
+    UnshardedEntryRule(),
+    TracerBranchRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.rule_id: r for r in ALL_RULES}

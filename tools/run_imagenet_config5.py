@@ -28,12 +28,17 @@ sys.path.insert(0, _REPO)
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-if jax.config.jax_compilation_cache_dir is None:
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(_REPO, "tests", ".jax_cache")
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Pre-0.5 JAX: the XLA flag works because the CPU backend
+    # has not initialized yet.
+    os.environ["XLA_FLAGS"] = os.environ.get(
+        "XLA_FLAGS", ""
+    ) + " --xla_force_host_platform_device_count=%d" % (8)
+from adanet_tpu.utils.compile_cache_dir import enable_persistent_cache
+
+enable_persistent_cache(os.path.join(_REPO, "tests", ".jax_cache"))
 
 # 20 steps demonstrates "runs + step time" but leaves the descent
 # ambiguous; 60 steps gives RMSProp's TF-style warm-started accumulator

@@ -19,30 +19,78 @@ checkpoint is just serialized pytrees plus a JSON manifest:
   which files are current. The iteration number lives in the checkpoint in
   the reference too (estimator.py:877-879) — it is what lets training
   stop/restart anywhere.
+
+Integrity contract (the self-healing half; see docs/robustness.md):
+every payload write leaves a `<file>.sha256` digest sidecar, and the
+manifest carries a `digests` map, a monotonically increasing
+`generation`, a per-completed-iteration `history` chain, and a
+`checksum` of its own canonical content. Reads verify before they
+deserialize; corruption raises `CheckpointCorruptionError` instead of
+returning garbage, and the restore path (via `robustness.integrity`)
+quarantines the corrupt file (`*.corrupt`) and rolls back to the newest
+intact generation. The previous manifest is retained at
+`checkpoint.json.prev` so a torn manifest degrades to "one write ago",
+and a model dir whose manifests are BOTH gone is reconstructed from the
+architecture chain rather than silently restarted from scratch.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import logging
 import os
+import re
 import tempfile
 from typing import Any, Dict, List, Optional
 
 import jax
 from flax import serialization
 
+from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.retry import retrying_open_read
+
+_LOG = logging.getLogger("adanet_tpu")
+
 MANIFEST = "checkpoint.json"
+MANIFEST_PREV = "checkpoint.json.prev"
+DIGEST_SUFFIX = ".sha256"
+QUARANTINE_SUFFIX = ".corrupt"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint artifact failed verification or deserialization.
+
+    Never retried (retrying cannot un-corrupt bytes); the restore path
+    catches it, quarantines the file, and rolls back.
+    """
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__("%s: %s" % (path, reason))
 
 
 @dataclasses.dataclass
 class CheckpointInfo:
-    """Parsed manifest contents."""
+    """Parsed manifest contents.
+
+    `generation` increments on every manifest write (the write chain);
+    `history` records one entry per COMPLETED iteration
+    (`{"iteration_number", "global_step", "generation"}`) so rollback
+    knows each iteration's end step; `digests` maps payload filenames to
+    their SHA-256 hex digests (duplicated in sidecar files so either
+    survives alone).
+    """
 
     iteration_number: int = 0
     global_step: int = 0
     iteration_state_file: Optional[str] = None
     replay_indices: List[int] = dataclasses.field(default_factory=list)
+    generation: int = 0
+    digests: Dict[str, str] = dataclasses.field(default_factory=dict)
+    history: List[Dict[str, int]] = dataclasses.field(default_factory=list)
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
@@ -92,67 +140,456 @@ def read_json(model_dir: str, filename: str):
         return json.load(f)
 
 
-def read_manifest(model_dir: str) -> Optional[CheckpointInfo]:
-    path = os.path.join(model_dir, MANIFEST)
+# ------------------------------------------------------------- integrity ops
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def digest_path(model_dir: str, filename: str) -> str:
+    return os.path.join(model_dir, filename + DIGEST_SUFFIX)
+
+
+def read_digest(model_dir: str, filename: str) -> Optional[str]:
+    """The recorded SHA-256 of a payload file; None when no sidecar."""
+    path = digest_path(model_dir, filename)
     if not os.path.exists(path):
         return None
-    with open(path) as f:
-        obj = json.load(f)
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError:
+        return None
+    return text if re.fullmatch(r"[0-9a-f]{64}", text) else None
+
+
+def _write_digest(model_dir: str, filename: str, data: bytes) -> str:
+    digest = sha256_hex(data)
+    _atomic_write_bytes(
+        digest_path(model_dir, filename), digest.encode()
+    )
+    return digest
+
+
+def remove_digest(model_dir: str, filename: str) -> None:
+    """Drops a payload's digest sidecar (rewrite protocol / cleanup).
+
+    Payload writes go remove-sidecar -> payload -> sidecar: a crash in
+    either window leaves NO sidecar (the decode check still validates
+    the payload), never a stale digest that would falsely quarantine an
+    intact file.
+    """
+    try:
+        os.unlink(digest_path(model_dir, filename))
+    except OSError:
+        pass
+
+
+def verify_file(
+    model_dir: str,
+    filename: str,
+    expected: Optional[str] = None,
+) -> Optional[bool]:
+    """Checks a payload against its recorded digest.
+
+    Returns True/False on a verdict, or None when the file exists but no
+    digest is recorded (legacy dirs: content checks must decide). A
+    missing file is False.
+    """
+    path = os.path.join(model_dir, filename)
+    if not os.path.exists(path):
+        return False
+    expected = expected or read_digest(model_dir, filename)
+    if expected is None:
+        return None
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest() == expected
+
+
+def quarantine_file(model_dir: str, filename: str) -> Optional[str]:
+    """Renames a corrupt artifact to `<name>.corrupt` (kept, diagnosable).
+
+    Returns the quarantined name, or None when the file is absent. The
+    digest sidecar rides along so post-mortems can see what was expected.
+    """
+    path = os.path.join(model_dir, filename)
+    if not os.path.exists(path):
+        return None
+    target = filename + QUARANTINE_SUFFIX
+    n = 0
+    while os.path.exists(os.path.join(model_dir, target)):
+        n += 1
+        target = "%s%s.%d" % (filename, QUARANTINE_SUFFIX, n)
+    try:
+        os.replace(path, os.path.join(model_dir, target))
+    except FileNotFoundError:
+        # Concurrent healing (several processes of a multi-host run all
+        # read the same corrupt file): one process wins the rename, the
+        # rest observe the file already gone — same outcome.
+        return None
+    sidecar = digest_path(model_dir, filename)
+    try:
+        os.replace(
+            sidecar, os.path.join(model_dir, target + DIGEST_SUFFIX)
+        )
+    except OSError:
+        pass
+    _LOG.error(
+        "Quarantined corrupt checkpoint artifact %s -> %s", filename, target
+    )
+    return target
+
+
+# --------------------------------------------------------------- manifest IO
+
+
+def _manifest_obj(info: CheckpointInfo) -> Dict[str, Any]:
+    obj = {
+        "iteration_number": info.iteration_number,
+        "global_step": info.global_step,
+        "iteration_state_file": info.iteration_state_file,
+        "replay_indices": info.replay_indices,
+        "generation": info.generation,
+        "digests": info.digests,
+        "history": info.history,
+    }
+    obj["checksum"] = sha256_hex(
+        json.dumps(obj, sort_keys=True).encode()
+    )
+    return obj
+
+
+def _parse_manifest(data: bytes, path: str) -> CheckpointInfo:
+    try:
+        obj = json.loads(data)
+    except ValueError as exc:
+        raise CheckpointCorruptionError(path, "unparseable JSON: %s" % exc)
+    if not isinstance(obj, dict) or "iteration_number" not in obj:
+        raise CheckpointCorruptionError(path, "not a manifest object")
+    checksum = obj.pop("checksum", None)
+    if checksum is not None:
+        expected = sha256_hex(json.dumps(obj, sort_keys=True).encode())
+        if checksum != expected:
+            raise CheckpointCorruptionError(
+                path, "manifest checksum mismatch"
+            )
     return CheckpointInfo(
         iteration_number=int(obj["iteration_number"]),
         global_step=int(obj["global_step"]),
         iteration_state_file=obj.get("iteration_state_file"),
         replay_indices=list(obj.get("replay_indices", [])),
+        generation=int(obj.get("generation", 0)),
+        digests=dict(obj.get("digests", {})),
+        history=list(obj.get("history", [])),
     )
+
+
+def read_manifest(
+    model_dir: str, quarantine: bool = True
+) -> Optional[CheckpointInfo]:
+    """Reads the manifest, healing over a corrupt main copy.
+
+    Order: `checkpoint.json` (checksum-verified) → `checkpoint.json.prev`
+    (the retained previous generation) → reconstruction from the
+    architecture chain. A corrupt main manifest is quarantined unless
+    `quarantine` is False (fsck's report-only mode and non-chief
+    processes of a multi-host run read without mutating the dir; the
+    chief's repair pass quarantines for everyone). Returns None only for
+    a genuinely fresh model dir.
+    """
+    faults.trip("manifest.read")
+    path = os.path.join(model_dir, MANIFEST)
+    if os.path.exists(path):
+        try:
+            return _parse_manifest(
+                retrying_open_read(path, label="manifest read"), path
+            )
+        except FileNotFoundError:
+            # A concurrent heal (the chief's repair pass) quarantined
+            # the corrupt file between the exists check and the read;
+            # fall through to the same fallbacks it used.
+            pass
+        except CheckpointCorruptionError as exc:
+            _LOG.error("Manifest corrupt (%s); trying fallbacks.", exc)
+            if quarantine:
+                quarantine_file(model_dir, MANIFEST)
+    prev = os.path.join(model_dir, MANIFEST_PREV)
+    if os.path.exists(prev):
+        try:
+            info = _parse_manifest(
+                retrying_open_read(prev, label="manifest.prev read"), prev
+            )
+            _LOG.warning(
+                "Recovered manifest from previous generation %d "
+                "(checkpoint.json.prev).",
+                info.generation,
+            )
+            return info
+        except FileNotFoundError:
+            pass
+        except CheckpointCorruptionError as exc:
+            _LOG.error("Previous manifest also corrupt (%s).", exc)
+            if quarantine:
+                quarantine_file(model_dir, MANIFEST_PREV)
+    return _reconstruct_manifest(model_dir)
+
+
+def manifest_intact(model_dir: str) -> bool:
+    """True when `checkpoint.json` exists and parses checksum-clean."""
+    path = os.path.join(model_dir, MANIFEST)
+    try:
+        _parse_manifest(
+            retrying_open_read(path, label="manifest check"), path
+        )
+        return True
+    except (FileNotFoundError, CheckpointCorruptionError):
+        return False
+
+
+def _reconstruct_manifest(model_dir: str) -> Optional[CheckpointInfo]:
+    """Last-resort manifest from the on-disk artifact chain.
+
+    Uses the longest contiguous prefix of parseable
+    `architecture-<t>.json` files (each carries the global step at its
+    iteration's end and the replay chain) plus the newest
+    digest-verified `ckpt-*.msgpack` beyond that step. Returns None when
+    the dir holds no artifacts at all (a fresh run).
+    """
+    if not os.path.isdir(model_dir):
+        return None
+    t = 0
+    last_arch = None
+    while True:
+        path = os.path.join(model_dir, architecture_filename(t))
+        if not os.path.exists(path):
+            break
+        try:
+            with open(path) as f:
+                last_arch = json.load(f)
+        except (OSError, ValueError):
+            break
+        t += 1
+    state_file = None
+    global_step = int(last_arch.get("global_step", 0)) if last_arch else 0
+    best_step = global_step
+    for name in os.listdir(model_dir):
+        match = re.fullmatch(r"ckpt-(\d+)\.msgpack", name)
+        if not match:
+            continue
+        step = int(match.group(1))
+        if step >= best_step and verify_file(model_dir, name):
+            best_step = step
+            state_file = name
+    if t == 0 and state_file is None:
+        return None
+    info = CheckpointInfo(
+        iteration_number=t,
+        global_step=best_step if state_file else global_step,
+        iteration_state_file=state_file,
+        replay_indices=(
+            list(last_arch.get("replay_indices", [])) if last_arch else []
+        ),
+    )
+    _LOG.error(
+        "Both manifests unusable; reconstructed from artifacts: "
+        "iteration %d, global step %d, state file %s. Run "
+        "tools/ckpt_fsck.py --repair to persist and verify.",
+        info.iteration_number,
+        info.global_step,
+        info.iteration_state_file,
+    )
+    return info
 
 
 def write_manifest(model_dir: str, info: CheckpointInfo) -> None:
+    """Writes the manifest (atomic), retaining the previous generation.
+
+    Bumps `info.generation`; the superseded manifest bytes move to
+    `checkpoint.json.prev` so one torn/bit-rotted write never loses the
+    whole chain.
+    """
     os.makedirs(model_dir, exist_ok=True)
-    _atomic_write_json(
-        os.path.join(model_dir, MANIFEST),
-        {
-            "iteration_number": info.iteration_number,
-            "global_step": info.global_step,
-            "iteration_state_file": info.iteration_state_file,
-            "replay_indices": info.replay_indices,
-        },
-    )
+    path = os.path.join(model_dir, MANIFEST)
+    if os.path.exists(path):
+        try:
+            _atomic_write_bytes(
+                os.path.join(model_dir, MANIFEST_PREV),
+                retrying_open_read(path, label="manifest backup"),
+            )
+        except OSError as exc:  # keep the write going; .prev is a bonus
+            _LOG.warning("Could not retain previous manifest: %s", exc)
+    info.generation += 1
+    # Digests for files that no longer exist are dead weight (superseded
+    # ckpt-* files are deleted); drop them as we go.
+    info.digests = {
+        name: digest
+        for name, digest in info.digests.items()
+        if os.path.exists(os.path.join(model_dir, name))
+    }
+    _atomic_write_json(path, _manifest_obj(info))
+
+
+# ------------------------------------------------------------ payload IO
 
 
 def save_pytree(model_dir: str, filename: str, payload: Any) -> str:
-    """Serializes a pytree (flax state-dict encoding) atomically."""
+    """Serializes a pytree (flax state-dict encoding) atomically.
+
+    Returns the payload's SHA-256 hex digest (also written to the
+    sidecar), for callers recording it in the manifest."""
     os.makedirs(model_dir, exist_ok=True)
     data = serialization.to_bytes(jax.device_get(payload))
-    _atomic_write_bytes(os.path.join(model_dir, filename), data)
-    return filename
+    path = os.path.join(model_dir, filename)
+    faults.trip("checkpoint.write", path=path, data=data)
+    remove_digest(model_dir, filename)
+    _atomic_write_bytes(path, data)
+    return _write_digest(model_dir, filename, data)
+
+
+def _read_verified(model_dir: str, filename: str) -> bytes:
+    path = os.path.join(model_dir, filename)
+    data = retrying_open_read(path, label="checkpoint read")
+    expected = read_digest(model_dir, filename)
+    if expected is not None and sha256_hex(data) != expected:
+        raise CheckpointCorruptionError(
+            path,
+            "SHA-256 mismatch (expected %s..., got %s...): torn write or "
+            "bit rot" % (expected[:12], sha256_hex(data)[:12]),
+        )
+    return data
 
 
 def restore_pytree(model_dir: str, filename: str, target: Any) -> Any:
-    """Restores a pytree saved by `save_pytree` onto a matching target."""
-    with open(os.path.join(model_dir, filename), "rb") as f:
-        return serialization.from_bytes(target, f.read())
+    """Restores a pytree saved by `save_pytree` onto a matching target.
+
+    Verifies the payload digest before deserializing; wraps decode
+    failures in `CheckpointCorruptionError`. Legacy NASNet checkpoints
+    missing the `batch_stats` `count` leaf (written before the
+    warmup-scheduled BatchNorm) are migrated in flight: the template
+    tells us exactly which count leaves are expected, and absent ones
+    are injected as converged (see `_inject_missing_count`).
+    """
+    path = os.path.join(model_dir, filename)
+    data = _read_verified(model_dir, filename)
+    try:
+        state_dict = serialization.msgpack_restore(data)
+    except Exception as exc:
+        raise CheckpointCorruptionError(
+            path, "undecodable msgpack: %s" % exc
+        ) from exc
+    template = serialization.to_state_dict(jax.device_get(target))
+    state_dict, injected = _inject_missing_count(state_dict, template)
+    if injected:
+        _LOG.warning(
+            "Migrated legacy checkpoint %s: injected %d missing "
+            "batch_stats `count` leaves (legacy statistics treated as "
+            "converged).",
+            filename,
+            injected,
+        )
+    try:
+        return serialization.from_state_dict(target, state_dict)
+    except Exception as exc:
+        raise CheckpointCorruptionError(
+            path, "state does not match target structure: %s" % exc
+        ) from exc
 
 
 def save_payload(model_dir: str, filename: str, payload: Any) -> str:
     """Serializes a plain payload (dicts/lists/arrays) without re-keying.
 
     Unlike `save_pytree`, lists stay lists (`to_bytes` would convert them to
-    string-keyed dicts via the state-dict encoding).
+    string-keyed dicts via the state-dict encoding). Returns the
+    payload's SHA-256 hex digest, like `save_pytree`.
     """
     os.makedirs(model_dir, exist_ok=True)
     data = serialization.msgpack_serialize(jax.device_get(payload))
-    _atomic_write_bytes(os.path.join(model_dir, filename), data)
-    return filename
+    path = os.path.join(model_dir, filename)
+    faults.trip("checkpoint.write", path=path, data=data)
+    remove_digest(model_dir, filename)
+    _atomic_write_bytes(path, data)
+    return _write_digest(model_dir, filename, data)
 
 
 def restore_payload(model_dir: str, filename: str) -> Any:
     """Restores a payload as plain dicts/lists (no target structure needed).
 
     Used for frozen-ensemble payloads, which are plain nested dicts of
-    arrays/primitives by construction.
+    arrays/primitives by construction. Digest-verified like
+    `restore_pytree`.
     """
-    with open(os.path.join(model_dir, filename), "rb") as f:
-        return serialization.msgpack_restore(f.read())
+    path = os.path.join(model_dir, filename)
+    data = _read_verified(model_dir, filename)
+    try:
+        return serialization.msgpack_restore(data)
+    except Exception as exc:
+        raise CheckpointCorruptionError(
+            path, "undecodable msgpack: %s" % exc
+        ) from exc
+
+
+# ----------------------------------------------- legacy batch_stats shim
+
+
+def _legacy_converged_count() -> float:
+    """The `count` at which the warmup-scheduled BatchNorm momentum has
+    converged to its asymptote: checkpoints from before the count leaf
+    existed carry long-run statistics, so "converged" is the faithful
+    migration (ADVICE r5)."""
+    try:
+        from adanet_tpu.models.nasnet import legacy_batch_stats_count
+
+        return float(legacy_batch_stats_count())
+    except Exception:  # models extra not importable: use the defaults
+        momentum, warmup = 0.9997, 10.0
+        return warmup * momentum / (1.0 - momentum)
+
+
+def _inject_missing_count(state_dict, template):
+    """Template-guided migration of legacy BatchNorm statistics.
+
+    Wherever the TEMPLATE has a `{"mean", "var", "count"}` stats dict
+    and the restored state has the mean/var but no count (a pre-round-5
+    NASNet checkpoint), a converged count scalar is injected. Guided by
+    the template, so collections that legitimately lack a count (e.g.
+    `nn.BatchNorm`) are never touched. Returns (migrated, n_injected).
+    """
+    import numpy as np
+
+    injected = 0
+
+    def walk(state, tmpl):
+        nonlocal injected
+        if not isinstance(state, dict) or not isinstance(tmpl, dict):
+            return state
+        if (
+            "count" in tmpl
+            and "count" not in state
+            and "mean" in tmpl
+            and "var" in tmpl
+            and "mean" in state
+            and "var" in state
+        ):
+            state = dict(state)
+            state["count"] = np.asarray(
+                _legacy_converged_count(), np.float32
+            )
+            injected += 1
+        return {
+            key: (
+                walk(value, tmpl[key]) if key in tmpl else value
+            )
+            for key, value in state.items()
+        }
+
+    return walk(state_dict, template), injected
+
+
+# ------------------------------------------------------------- file naming
 
 
 def frozen_filename(iteration_number: int) -> str:

@@ -33,6 +33,9 @@ from typing import Any, Optional, Tuple
 import jax
 import numpy as np
 
+from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.retry import with_retries
+
 
 def _leaf_spec(leaf) -> Tuple:
     # Raw hashable objects, no repr strings: jax shardings hash their
@@ -113,7 +116,18 @@ class CompileCache:
         key = (digest, _device_fingerprint(args), in_tree, out_tree)
         executable = self._executables.get(key)
         if executable is None:
-            executable = lowered.compile()
+            # The compile may read a persistent on-disk XLA cache (see
+            # utils/compile_cache_dir.py): a transient I/O error there —
+            # or at the `compile_cache.read` fault site chaos runs arm —
+            # is retried with bounded deterministic backoff instead of
+            # killing a multi-hour search over one EIO.
+            def compile_once():
+                faults.trip("compile_cache.read")
+                return lowered.compile()
+
+            executable = with_retries(
+                compile_once, label="compile-cache read"
+            )
             self._executables[key] = executable
             self.misses += 1
             while len(self._executables) > self._max_entries:

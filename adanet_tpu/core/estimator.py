@@ -62,6 +62,9 @@ from adanet_tpu.distributed.mesh import (
 from adanet_tpu.distributed.placement import RoundRobinStrategy
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
+from adanet_tpu.robustness import faults as faults_lib
+from adanet_tpu.robustness import retry as retry_lib
+from adanet_tpu.robustness import watchdog as watchdog_lib
 from adanet_tpu.utils import (
     WeightedMeanAccumulator,
     batch_example_count,
@@ -75,6 +78,20 @@ def _crossed(prev_step: int, step: int, interval: int) -> bool:
     """True when [prev_step, step] crossed a multiple of `interval` (steps
     may advance by more than 1 under iterations_per_loop > 1)."""
     return step // interval > prev_step // interval
+
+
+def _force_candidates_dead(state, names):
+    """Forces the quarantine flag on named candidates (host-side state).
+
+    The placement-layer analogue of the NaN quarantine inside the train
+    step (`candidate.update_candidate_state`): a candidate whose submesh
+    or peer faulted gets `dead=True`, so `debiased_ema` returns +inf and
+    selection can never pick it."""
+    cands = dict(state.candidates)
+    for name in names:
+        if name in cands:
+            cands[name] = cands[name].replace(dead=np.asarray(True))
+    return state.replace(candidates=cands)
 
 
 def _same_shapes(batches) -> bool:
@@ -342,7 +359,36 @@ class Estimator:
         else:
             self._spmd_mesh = None
 
-        info = ckpt_lib.read_manifest(self._model_dir) or ckpt_lib.CheckpointInfo()
+        # Verify-and-heal BEFORE trusting any restored bytes: corrupt
+        # files are quarantined (`*.corrupt`) and the manifest rolls back
+        # to the newest intact generation, so a torn write or bit rot
+        # costs re-training one iteration instead of a crash (the fsck
+        # pass is deterministic; every process computes the same healed
+        # state while only the chief persists it).
+        from adanet_tpu.robustness import integrity
+
+        heal = integrity.fsck(
+            self._model_dir, repair=coordination.is_chief()
+        )
+        if heal.rolled_back_to_iteration is not None:
+            _LOG.warning(
+                "Checkpoint healed: rolled back to iteration %d "
+                "(global step %s); quarantined %s.",
+                heal.rolled_back_to_iteration,
+                heal.rolled_back_global_step,
+                heal.quarantined or heal.issues,
+            )
+        info = heal.info or ckpt_lib.CheckpointInfo()
+        # Degraded mode: set once a multi-host peer is declared lost;
+        # collective agreement (stop checks, bookkeeping) then falls back
+        # to process-local behavior and the search stops at the next
+        # iteration boundary, resumable from the checkpoint.
+        self._peer_lost: Optional[watchdog_lib.PeerLostError] = None
+        heartbeat = None
+        if jax.process_count() > 1 and coordination.is_chief():
+            heartbeat = watchdog_lib.HeartbeatWriter(
+                self._model_dir, role="chief"
+            ).start()
         data_iter: Optional[Iterator] = None
         # In-memory winner of the previous loop pass; avoids replaying the
         # whole rebuild chain every iteration (disk rebuild happens only on
@@ -390,6 +436,8 @@ class Estimator:
                 input_fn, max_steps, info, data_iter, cached_previous
             )
         finally:
+            if heartbeat is not None:
+                heartbeat.stop()
             if handler_installed:
                 signal.signal(
                     signal.SIGTERM,
@@ -418,13 +466,36 @@ class Estimator:
         (deadlock). Under SPMD every process allgathers its flag at the
         SAME boundaries, so all stop iff ANY was signaled.
         """
-        if self._spmd_mesh is None:
+        if self._spmd_mesh is None or self._peer_lost is not None:
+            # Degraded (peer lost): the dead transport would hang the
+            # agreement; survivors decide locally and stop at the next
+            # iteration boundary anyway.
             return self._stop_requested
-        from jax.experimental import multihost_utils
+        # The agreement rides the coordination-service KV store, NOT a
+        # device collective: abandoning a timed-out process_allgather
+        # would wedge the local runtime (multihost._broadcast_tree's
+        # design note), hanging the very checkpoint-and-stop path this
+        # agreement is meant to trigger. The outer deadline only covers
+        # a wedged gRPC channel (grace on top of the KV timeout).
+        from adanet_tpu.distributed.multihost import allgather_host_flag
 
-        flags = multihost_utils.process_allgather(
-            np.asarray(self._stop_requested, np.int32)
-        )
+        timeout = watchdog_lib.collective_timeout_secs()
+        try:
+            flags = watchdog_lib.call_with_deadline(
+                lambda: allgather_host_flag(
+                    int(self._stop_requested), label="stop agreement"
+                ),
+                None if timeout is None else timeout + 10.0,
+                "stop agreement",
+            )
+        except watchdog_lib.PeerLostError as exc:
+            # A peer death can surface here first: route it into the
+            # same degradation path the executor uses (finish locally,
+            # checkpoint, stop at the boundary) instead of crashing
+            # mid-iteration with survivor progress unsaved.
+            _LOG.error("Peer lost at the stop agreement: %s", exc)
+            self._peer_lost = exc
+            return True
         return bool(np.max(flags))
 
     def _stop_check_interval(self) -> int:
@@ -451,7 +522,7 @@ class Estimator:
         cadence — every process evaluates the same arithmetic on the same
         `steps_done`, so they enter the allgather together or not at all.
         """
-        if self._spmd_mesh is None:
+        if self._spmd_mesh is None or self._peer_lost is not None:
             return self._stop_requested
         if steps_done - self._last_stop_check_step < self._stop_check_interval():
             return False
@@ -625,6 +696,16 @@ class Estimator:
                     steps_done += 1
                     info.global_step += 1
 
+                if (
+                    executor is not None
+                    and executor.is_multihost
+                    and self._peer_lost is None
+                    and executor.lost_peers
+                ):
+                    # The executor declared a peer dead mid-iteration
+                    # (collective watchdog): finish the iteration with
+                    # the survivors, then stop at the boundary below.
+                    self._peer_lost = executor.peer_lost_error
                 if profiling and steps_done >= profile_stop_at:
                     jax.block_until_ready(metrics)
                     jax.profiler.stop_trace()
@@ -658,13 +739,32 @@ class Estimator:
                     self._save_checkpoint_steps,
                 ):
                     if executor is not None and executor.is_multihost:
-                        # State pieces live on different processes'
-                        # submeshes: every process joins the collective
-                        # gather at this deterministic boundary; only the
-                        # chief persists.
-                        host_state = executor.gather(state)
-                        if coordination.is_chief():
-                            self._save_iteration_state(info, t, host_state)
+                        if executor.lost_peers:
+                            # With collectives disabled, gather returns
+                            # the zeros template for unreachable groups
+                            # and this boundary carries no dead marks
+                            # (those are forced at iteration end) — a
+                            # restart would silently resume zeroed
+                            # subnetworks as healthy. Keep the previous
+                            # checkpoint; the iteration-boundary save
+                            # below persists the survivors with the dead
+                            # set forced into the state.
+                            _LOG.warning(
+                                "Skipping mid-iteration checkpoint at "
+                                "global step %d: peer lost, partial "
+                                "gather would checkpoint zeroed groups.",
+                                info.global_step,
+                            )
+                        else:
+                            # State pieces live on different processes'
+                            # submeshes: every process joins the
+                            # collective gather at this deterministic
+                            # boundary; only the chief persists.
+                            host_state = executor.gather(state)
+                            if coordination.is_chief():
+                                self._save_iteration_state(
+                                    info, t, host_state
+                                )
                     elif coordination.is_chief():
                         self._save_iteration_state(info, t, state)
 
@@ -686,7 +786,23 @@ class Estimator:
                 # process receives every group's state over DCN, then the
                 # bookkeeping programs run replicated over the full mesh.
                 state = executor.gather(state)
-                if self._spmd_mesh is not None:
+                dead = executor.dead_candidate_names()
+                if dead:
+                    # Faulted candidates join the NaN-quarantine path:
+                    # forcing `CandidateState.dead` excludes them from
+                    # selection exactly like a non-finite loss would.
+                    state = _force_candidates_dead(state, dead)
+                    _LOG.warning(
+                        "Iteration %d completing with quarantined "
+                        "candidates excluded from selection: %s",
+                        t,
+                        sorted(dead),
+                    )
+                if executor.is_multihost and executor.lost_peers:
+                    self._peer_lost = (
+                        self._peer_lost or executor.peer_lost_error
+                    )
+                if self._spmd_mesh is not None and self._peer_lost is None:
                     state = replicate_state(state, self._spmd_mesh)
 
             if steps_done < self._max_iteration_steps:
@@ -705,6 +821,36 @@ class Estimator:
                     )
                 break
 
+            if self._peer_lost is not None:
+                # Graceful degradation: the cluster's collectives are
+                # gone, so bookkeeping runs process-LOCAL on the chief
+                # (the gathered survivor state is host-resident; lost
+                # groups' candidates are quarantined or carry infinite
+                # EMAs — never selectable). The search then stops at
+                # this boundary: durable state is complete, and a
+                # restart re-forms the cluster and resumes.
+                self._spmd_mesh = None
+                if coordination.is_chief():
+                    cached_previous = self._complete_iteration(
+                        iteration, state, sample_batch, info
+                    )
+                else:
+                    coordination.wait_for_iteration(
+                        self._model_dir,
+                        t + 1,
+                        timeout_secs=self._worker_wait_timeout_secs,
+                        heartbeat_timeout_secs=(
+                            watchdog_lib.heartbeat_timeout_secs()
+                        ),
+                    )
+                _LOG.error(
+                    "Stopping the search after iteration %d (%s). All "
+                    "surviving candidates finished and the checkpoint is "
+                    "durable; restart to re-form the cluster and resume.",
+                    t,
+                    self._peer_lost,
+                )
+                break
             if self._spmd_mesh is not None:
                 # SPMD bookkeeping: selection/eval/freeze are collective
                 # programs over the process-spanning mesh, so EVERY
@@ -728,6 +874,9 @@ class Estimator:
                         self._model_dir,
                         t + 1,
                         timeout_secs=self._worker_wait_timeout_secs,
+                        heartbeat_timeout_secs=(
+                            watchdog_lib.heartbeat_timeout_secs()
+                        ),
                     )
             elif coordination.is_chief():
                 cached_previous = self._complete_iteration(
@@ -740,6 +889,11 @@ class Estimator:
                     self._model_dir,
                     t + 1,
                     timeout_secs=self._worker_wait_timeout_secs,
+                    heartbeat_timeout_secs=(
+                        watchdog_lib.heartbeat_timeout_secs()
+                        if jax.process_count() > 1
+                        else None
+                    ),
                 )
                 cached_previous = None
 
@@ -770,22 +924,45 @@ class Estimator:
         except ValueError:
             pass
 
-    def _next_batch(self, input_fn, data_iter):
-        if data_iter is None:
-            data_iter = self._make_train_iter(input_fn)
-        try:
-            batch = next(data_iter)
-        except StopIteration:
-            # Release the exhausted iterator's bookkeeping before
-            # replacing it — a long search crosses many epoch boundaries
-            # and must not retain every dead prefetcher until train()
-            # returns.
-            self._close_iter(data_iter)
-            data_iter = self._make_train_iter(input_fn)
+    def _next_batch(self, input_fn, data_iter, _attempts: int = 3):
+        for attempt in range(_attempts):
+            if data_iter is None:
+                data_iter = self._make_train_iter(input_fn)
             try:
+                faults_lib.trip("data.pull")
                 batch = next(data_iter)
+                break
             except StopIteration:
-                raise ValueError("input_fn yielded no batches.")
+                # Release the exhausted iterator's bookkeeping before
+                # replacing it — a long search crosses many epoch
+                # boundaries and must not retain every dead prefetcher
+                # until train() returns.
+                self._close_iter(data_iter)
+                data_iter = self._make_train_iter(input_fn)
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    raise ValueError("input_fn yielded no batches.")
+                break
+            except Exception as exc:
+                # A transient data-source hiccup (network filesystem,
+                # remote dataset service) must not kill the search: the
+                # pipeline is re-opened and the pull retried, bounded
+                # and deterministic. A generator cannot be resumed after
+                # it raised, so re-creation is the only safe retry.
+                if attempt == _attempts - 1 or not retry_lib.is_transient(
+                    exc
+                ):
+                    raise
+                _LOG.warning(
+                    "Transient data-source failure (pull attempt %d/%d): "
+                    "%s; re-opening the input pipeline.",
+                    attempt + 1,
+                    _attempts,
+                    exc,
+                )
+                self._close_iter(data_iter)
+                data_iter = None
         if self._debug:
             self._check_batch_finite(batch)
         return batch, data_iter
@@ -1070,13 +1247,66 @@ class Estimator:
             self._iteration_rng(iteration.iteration_number), sample_batch
         )
         if info.iteration_state_file:
-            state = ckpt_lib.restore_pytree(
-                self._model_dir, info.iteration_state_file, state
-            )
-            _LOG.info(
-                "Restored mid-iteration state from %s",
-                info.iteration_state_file,
-            )
+            restored = None
+            try:
+                restored = ckpt_lib.restore_pytree(
+                    self._model_dir, info.iteration_state_file, state
+                )
+            except (ckpt_lib.CheckpointCorruptionError, OSError) as exc:
+                # Verify-on-restore tripped on a file the pre-train fsck
+                # pass considered intact (bit rot between scans, or a
+                # decode-level mismatch): quarantine and degrade to
+                # "restart this iteration from its first step" on the
+                # fresh deterministic init above. OSError covers the
+                # multi-host race where the chief's concurrent heal just
+                # quarantined the file out from under this process.
+                _LOG.error(
+                    "Mid-iteration state corrupt at restore time (%s); "
+                    "rolling back to the start of iteration %d.",
+                    exc,
+                    info.iteration_number,
+                )
+            failed = restored is None
+            if jax.process_count() > 1:
+                # The verdict must be COLLECTIVE: one process rolling
+                # back alone (only ITS read hit the rot) would carry a
+                # different global_step and fresh-init params into the
+                # replication below — silent divergence or misaligned
+                # collective boundaries. All roll back iff any failed.
+                from adanet_tpu.distributed.multihost import (
+                    allgather_host_flag,
+                )
+
+                try:
+                    failed = bool(
+                        np.max(
+                            allgather_host_flag(
+                                int(failed), label="restore agreement"
+                            )
+                        )
+                    )
+                except watchdog_lib.PeerLostError as exc:
+                    _LOG.error(
+                        "Peer lost at the restore agreement: %s", exc
+                    )
+                    self._peer_lost = exc  # degrade; local verdict stands
+            if failed:
+                stale = info.iteration_state_file
+                info.iteration_state_file = None
+                from adanet_tpu.robustness import integrity
+
+                info.global_step = integrity.end_step_of(
+                    info, self._model_dir, info.iteration_number
+                )
+                if coordination.is_chief():
+                    ckpt_lib.quarantine_file(self._model_dir, stale)
+                    ckpt_lib.write_manifest(self._model_dir, info)
+            else:
+                state = restored
+                _LOG.info(
+                    "Restored mid-iteration state from %s",
+                    info.iteration_state_file,
+                )
         if self._spmd_mesh is not None and replicate:
             # Replicate over the process-spanning mesh. Initialization is
             # deterministic (same seed, same shapes on every process), so
@@ -1087,7 +1317,9 @@ class Estimator:
     def _save_iteration_state(self, info, iteration_number, state) -> None:
         stale = info.iteration_state_file
         filename = ckpt_lib.iteration_state_filename(info.global_step)
-        ckpt_lib.save_pytree(self._model_dir, filename, state)
+        info.digests[filename] = ckpt_lib.save_pytree(
+            self._model_dir, filename, state
+        )
         info.iteration_number = iteration_number
         info.iteration_state_file = filename
         ckpt_lib.write_manifest(self._model_dir, info)
@@ -1102,6 +1334,9 @@ class Estimator:
             os.remove(os.path.join(self._model_dir, filename))
         except OSError:
             pass
+        # The digest sidecar dies with its payload (a long search must
+        # not accumulate one orphaned .sha256 per superseded ckpt).
+        ckpt_lib.remove_digest(self._model_dir, filename)
 
     # ------------------------------------------------- bookkeeping (between)
 
@@ -1172,8 +1407,9 @@ class Estimator:
             # Retain ALL candidates' final state (not just the winner) so
             # per-candidate comparison survives iteration completion
             # (reference: adanet/core/estimator.py:1683-1723).
-            ckpt_lib.save_pytree(
-                self._model_dir, ckpt_lib.final_state_filename(t), state
+            final_name = ckpt_lib.final_state_filename(t)
+            info.digests[final_name] = ckpt_lib.save_pytree(
+                self._model_dir, final_name, state
             )
 
         if write:
@@ -1186,8 +1422,9 @@ class Estimator:
                 f.write(frozen.architecture.serialize())
             payload = ckpt_lib.frozen_to_payload(frozen)
             payload["name"] = frozen.name
-            ckpt_lib.save_payload(
-                self._model_dir, ckpt_lib.frozen_filename(t), payload
+            frozen_name = ckpt_lib.frozen_filename(t)
+            info.digests[frozen_name] = ckpt_lib.save_payload(
+                self._model_dir, frozen_name, payload
             )
 
         if self._report_materializer:
@@ -1213,6 +1450,16 @@ class Estimator:
         info.iteration_number = t + 1
         info.iteration_state_file = None
         info.replay_indices = frozen.architecture.replay_indices
+        # The generation chain: one entry per COMPLETED iteration with
+        # its end step, so rollback after corruption knows exactly where
+        # each generation boundary sits (robustness/integrity.py).
+        info.history.append(
+            {
+                "iteration_number": t,
+                "global_step": int(info.global_step),
+                "generation": info.generation + 1,
+            }
+        )
         if write:
             ckpt_lib.write_manifest(self._model_dir, info)
             self._remove_state_file(stale_state)
@@ -1287,8 +1534,12 @@ class Estimator:
 
     def candidate_metrics(
         self, iteration_number: Optional[int] = None
-    ) -> Dict[str, Dict[str, float]]:
+    ) -> Dict[str, Dict[str, Any]]:
         """Per-candidate selection metrics of a completed iteration.
+
+        Entries mix value types by design: floats (losses/EMAs, or None
+        when non-finite), bools (`dead`, `best`), and ints
+        (`global_step`) — hence `Any` (ADVICE r5).
 
         Always available post-training with no constructor flag (written
         by every bookkeeping phase); `iteration_number` defaults to the

@@ -28,6 +28,7 @@ import jax
 
 from adanet_tpu.core import checkpoint as ckpt_lib
 from adanet_tpu.core.timer import CountDownTimer
+from adanet_tpu.robustness import watchdog
 
 _LOG = logging.getLogger("adanet_tpu")
 
@@ -84,6 +85,7 @@ def wait_for_iteration(
     iteration_number: int,
     timeout_secs: float = 7200.0,
     poll_interval_secs: float = 1.0,
+    heartbeat_timeout_secs: Optional[float] = None,
 ) -> ckpt_lib.CheckpointInfo:
     """Blocks until the manifest reaches `iteration_number`.
 
@@ -92,6 +94,14 @@ def wait_for_iteration(
     bookkeeping phase increments the iteration, then return the manifest.
     Raises `WorkerWaitTimeout` after `timeout_secs` (the reference logs and
     exits gracefully; callers may catch and do the same).
+
+    A DEAD chief is distinguished from a slow one via its heartbeat file
+    (`watchdog.HeartbeatWriter`, maintained during `Estimator.train`):
+    once a heartbeat has been observed, a staleness beyond
+    `heartbeat_timeout_secs` raises `PeerLostError` within seconds-to-
+    minutes instead of burning the full two-hour wait. Dirs without a
+    heartbeat (single-process runs, pre-heartbeat checkpoints) keep the
+    plain countdown.
     """
     timer = CountDownTimer(timeout_secs)
     while True:
@@ -103,6 +113,16 @@ def wait_for_iteration(
                 "Gave up waiting for the chief to write iteration %d to %s "
                 "after %.0fs." % (iteration_number, model_dir, timeout_secs)
             )
+        if heartbeat_timeout_secs is not None:
+            age = watchdog.heartbeat_age(model_dir, "chief")
+            if age is not None and age > heartbeat_timeout_secs:
+                raise watchdog.PeerLostError(
+                    "chief heartbeat",
+                    timeout_secs=heartbeat_timeout_secs,
+                    source_process=0,
+                    detail="heartbeat stale for %.1fs while waiting for "
+                    "iteration %d in %s" % (age, iteration_number, model_dir),
+                )
         _LOG.debug(
             "Waiting for chief to finish iteration %d (%.0fs remaining)",
             iteration_number - 1,

@@ -33,7 +33,8 @@ all-reduce over ICI.
 
 from __future__ import annotations
 
-from typing import Optional
+import logging
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,16 @@ from adanet_tpu.core import iteration as iteration_lib
 from adanet_tpu.core.iteration import Iteration, IterationState
 from adanet_tpu.distributed import mesh as mesh_lib
 from adanet_tpu.distributed.placement import RoundRobinStrategy
+from adanet_tpu.robustness.faults import InjectedFault
+from adanet_tpu.robustness.watchdog import PeerLostError
+
+_LOG = logging.getLogger("adanet_tpu")
+
+#: Failures that quarantine ONE candidate instead of killing the
+#: iteration: an injected chaos fault in its dispatch path, or the loss
+#: of the peer(s) hosting its submesh. Anything else propagates — a
+#: genuine bug must not be silently absorbed as "candidate died".
+CANDIDATE_FAULTS = (InjectedFault, PeerLostError)
 
 
 class RoundRobinExecutor:
@@ -70,6 +81,13 @@ class RoundRobinExecutor:
         self._host_step = 0
         self._last_sync_step = 0
         self._member_vars_cache = None
+        # Graceful degradation (reusing the NaN-quarantine idea at the
+        # placement layer): a candidate whose dispatch faults is marked
+        # dead here, its state freezes at the last good step, and the
+        # iteration continues with the survivors. Selection excludes it
+        # via `dead_candidate_names` (the estimator forces the candidate
+        # quarantine flag on the gathered state).
+        self._dead_subnetworks: Dict[str, str] = {}
 
         n = len(iteration.subnetwork_specs)
         self._n = n
@@ -271,6 +289,41 @@ class RoundRobinExecutor:
         }
         self._ens_mesh = self.strategy.ensemble_mesh(n)
 
+    # ----------------------------------------------------- fault quarantine
+
+    def _mark_subnetwork_dead(self, name: str, exc: BaseException) -> None:
+        reason = "%s: %s" % (type(exc).__name__, exc)
+        self._dead_subnetworks[name] = reason
+        _LOG.error(
+            "Candidate subnetwork %r quarantined (training continues "
+            "with survivors): %s",
+            name,
+            reason,
+        )
+
+    def dead_subnetworks(self) -> Dict[str, str]:
+        """Quarantined subnetworks and why (empty in a healthy run)."""
+        return dict(self._dead_subnetworks)
+
+    def dead_candidate_names(self) -> set:
+        """Ensemble candidates invalidated by quarantined subnetworks.
+
+        A candidate whose NEW member's group faulted trained on frozen
+        (stale) member parameters from the fault point on; its selection
+        signal is meaningless, so it joins the NaN-quarantine path (the
+        estimator forces `CandidateState.dead` on the gathered state)."""
+        if not self._dead_subnetworks:
+            return set()
+        dead = set(self._dead_subnetworks)
+        return {
+            espec.name
+            for espec in self.iteration.ensemble_specs
+            if any(
+                kind == iteration_lib._NEW and ref in dead
+                for kind, ref in espec.members
+            )
+        }
+
     # ------------------------------------------------------------------ state
 
     def init_state(self, rng, sample_batch) -> IterationState:
@@ -338,34 +391,44 @@ class RoundRobinExecutor:
         new_subnetworks = {}
         metrics = {}
         for i, spec in enumerate(self.iteration.subnetwork_specs):
+            if spec.name in self._dead_subnetworks:
+                # Quarantined: state freezes at its last good step.
+                new_subnetworks[spec.name] = state.subnetworks[spec.name]
+                continue
             sub_mesh = self._sub_meshes[spec.name]
             sub_batch = mesh_lib.shard_batch(
                 extra_batches.get(spec.name, (features, labels)), sub_mesh
             )
             rng_i = jax.random.fold_in(step_rng, i)
-            if self._needs_context[spec.name]:
-                if spec.name not in self._sub_frozen:
-                    raise ValueError(
-                        "State was not placed: call executor.init_state() "
-                        "or executor.place(state) before train_step when "
-                        "builders use custom losses with a previous "
-                        "ensemble (teacher copies live per submesh)."
+            try:
+                if self._needs_context[spec.name]:
+                    if spec.name not in self._sub_frozen:
+                        raise ValueError(
+                            "State was not placed: call executor."
+                            "init_state() or executor.place(state) before "
+                            "train_step when builders use custom losses "
+                            "with a previous ensemble (teacher copies "
+                            "live per submesh)."
+                        )
+                    new_st, loss, extra = self._sub_steps[spec.name](
+                        state.subnetworks[spec.name],
+                        self._sub_frozen[spec.name],
+                        self._sub_prev_params[spec.name],
+                        sub_batch[0],
+                        sub_batch[1],
+                        rng_i,
                     )
-                new_st, loss, extra = self._sub_steps[spec.name](
-                    state.subnetworks[spec.name],
-                    self._sub_frozen[spec.name],
-                    self._sub_prev_params[spec.name],
-                    sub_batch[0],
-                    sub_batch[1],
-                    rng_i,
-                )
-            else:
-                new_st, loss, extra = self._sub_steps[spec.name](
-                    state.subnetworks[spec.name],
-                    sub_batch[0],
-                    sub_batch[1],
-                    rng_i,
-                )
+                else:
+                    new_st, loss, extra = self._sub_steps[spec.name](
+                        state.subnetworks[spec.name],
+                        sub_batch[0],
+                        sub_batch[1],
+                        rng_i,
+                    )
+            except CANDIDATE_FAULTS as exc:
+                self._mark_subnetwork_dead(spec.name, exc)
+                new_subnetworks[spec.name] = state.subnetworks[spec.name]
+                continue
             new_subnetworks[spec.name] = new_st
             metrics["subnetwork_loss/%s" % spec.name] = loss
             metrics.update(extra)
@@ -437,6 +500,9 @@ class RoundRobinExecutor:
         new_subnetworks = {}
         metrics = {}
         for i, spec in enumerate(self.iteration.subnetwork_specs):
+            if spec.name in self._dead_subnetworks:
+                new_subnetworks[spec.name] = state.subnetworks[spec.name]
+                continue
             sub_mesh = self._sub_meshes[spec.name]
             sub_batch = mesh_lib.shard_batch(
                 (features, labels), sub_mesh, stacked=True
@@ -444,25 +510,31 @@ class RoundRobinExecutor:
             keys_i = jax.vmap(
                 lambda key, index=i: jax.random.fold_in(key, index)
             )(step_rngs)
-            if self._needs_context[spec.name]:
-                if spec.name not in self._sub_frozen:
-                    raise ValueError(
-                        "State was not placed: call executor.init_state() "
-                        "or executor.place(state) before train_steps when "
-                        "builders use custom losses with a previous "
-                        "ensemble (teacher copies live per submesh)."
+            try:
+                if self._needs_context[spec.name]:
+                    if spec.name not in self._sub_frozen:
+                        raise ValueError(
+                            "State was not placed: call executor."
+                            "init_state() or executor.place(state) before "
+                            "train_steps when builders use custom losses "
+                            "with a previous ensemble (teacher copies "
+                            "live per submesh)."
+                        )
+                    new_st, loss, extra = self._sub_multi_steps[spec.name](
+                        state.subnetworks[spec.name],
+                        self._sub_frozen[spec.name],
+                        self._sub_prev_params[spec.name],
+                        sub_batch,
+                        keys_i,
                     )
-                new_st, loss, extra = self._sub_multi_steps[spec.name](
-                    state.subnetworks[spec.name],
-                    self._sub_frozen[spec.name],
-                    self._sub_prev_params[spec.name],
-                    sub_batch,
-                    keys_i,
-                )
-            else:
-                new_st, loss, extra = self._sub_multi_steps[spec.name](
-                    state.subnetworks[spec.name], sub_batch, keys_i
-                )
+                else:
+                    new_st, loss, extra = self._sub_multi_steps[spec.name](
+                        state.subnetworks[spec.name], sub_batch, keys_i
+                    )
+            except CANDIDATE_FAULTS as exc:
+                self._mark_subnetwork_dead(spec.name, exc)
+                new_subnetworks[spec.name] = state.subnetworks[spec.name]
+                continue
             new_subnetworks[spec.name] = new_st
             metrics["subnetwork_loss/%s" % spec.name] = loss
             metrics.update(extra)

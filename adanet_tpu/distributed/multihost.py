@@ -24,13 +24,18 @@ chief — so selection EMAs and bookkeeping artifacts live where the writes
 happen, matching the reference's "task 0 builds/trains ensembles" rule.
 
 Member-parameter sync — the reference's O(m*n/k) parameter-server fetches
-(placement.py:141-148) — is a host-mediated broadcast over DCN: every
-`sync_every` steps each subnetwork group's first owner broadcasts its
-replicated parameters to all processes (`multihost_utils.
-broadcast_one_to_all`), and ensemble-group owners place them onto the
-ensemble submesh. Between sync points the groups run fully independently
-(async dispatch), so staleness semantics match the in-process executor
-(see `executor.py`'s staleness contract).
+(placement.py:141-148) — is a host-mediated broadcast: every
+`sync_every` steps each subnetwork group's first owner publishes its
+replicated parameters to all processes over the coordination-service KV
+store (`_broadcast_tree`; the coordinator plays the reference's
+parameter server), and ensemble-group owners place them onto the
+ensemble submesh. Host control-plane payloads deliberately avoid device
+collectives so a dead peer can never wedge the survivors' local runtime
+(see `_broadcast_tree` and docs/robustness.md); the device DATA plane —
+in-program gradient psums over ICI/DCN — is untouched. Between sync
+points the groups run fully independently (async dispatch), so
+staleness semantics match the in-process executor (see `executor.py`'s
+staleness contract).
 
 Data semantics match the reference, where each worker runs its own input
 pipeline: every process feeds its LOCAL batch; a group's effective
@@ -42,6 +47,8 @@ tests/test_distributed.py's multi-host RoundRobin oracle test).
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,8 +57,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from adanet_tpu.core.iteration import Iteration, IterationState
 from adanet_tpu.distributed import mesh as mesh_lib
-from adanet_tpu.distributed.executor import RoundRobinExecutor
+from adanet_tpu.distributed.executor import (
+    CANDIDATE_FAULTS,
+    RoundRobinExecutor,
+)
 from adanet_tpu.distributed.placement import RoundRobinStrategy
+from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.watchdog import (
+    PeerLostError,
+    call_with_deadline,
+    collective_timeout_secs,
+)
+
+_LOG = logging.getLogger("adanet_tpu")
 
 
 def multihost_candidate_groups(
@@ -122,41 +140,218 @@ def multihost_candidate_groups(
     return groups, owners
 
 
-def _broadcast_tree(payload, is_source: bool):
-    """`broadcast_one_to_all` with the whole pytree fused into ONE leaf.
+#: gRPC caps messages at 4 MiB; payloads are chunked below it.
+_KV_CHUNK_BYTES = 2 << 20
+#: Broadcast keys older than this many sequence numbers are deleted by
+#: their source. Every process performs at least one blocking get per
+#: sync round (with >= 2 processes it never owns every group), so
+#: processes stay within one round of each other and a 64-sequence lag
+#: can never delete a key a receiver still needs.
+_KV_GC_LAG = 64
+#: Retained payloads live in the COORDINATOR's memory until GC'd; with
+#: realistic member-variable blobs a flat 64-sequence lag would park
+#: gigabytes there. When this process's retained bytes exceed the budget
+#: (`ADANET_KV_GC_BYTES`, default 256 MiB), GC tightens to
+#: `_KV_GC_MIN_LAG` — which must still exceed one sync round's broadcast
+#: count (one per candidate group, so raise the env knob past the
+#: default 16 only for searches with more candidates than that).
+_KV_GC_MIN_LAG = 16
+_KV_GC_DEFAULT_BYTES = 256 << 20
 
-    multihost_utils broadcasts leaf-by-leaf: a multi-leaf payload becomes
-    several independent all-reduces in one XLA program, which the CPU
-    thunk executor is free to run concurrently — gloo then interleaves
-    their frames on the shared TCP pair and aborts the process
-    ("op.preamble.length <= op.nbytes"). Packing the tree into a single
-    uint8 blob issues exactly one collective per broadcast; it also turns
-    one DCN round per leaf into one per variable set, the same batching
-    the reference applies to its parameter-server fetches.
+_broadcast_seq = [0]
+_kv_keys_set: list = []  # (seq, [keys], nbytes) this process wrote
+_kv_bytes_retained = [0]
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _LOG.warning("Ignoring non-integer %s=%r.", name, raw)
+        return default
+
+
+def _kv_gc_limits() -> Tuple[int, int]:
+    """(min_lag, byte_budget) for source-side KV GC, env-overridable."""
+    return (
+        max(1, _env_int("ADANET_KV_GC_MIN_LAG", _KV_GC_MIN_LAG)),
+        max(0, _env_int("ADANET_KV_GC_BYTES", _KV_GC_DEFAULT_BYTES)),
+    )
+
+
+def _kv_client():
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def _broadcast_tree(
+    payload,
+    is_source: bool,
+    timeout_secs: Optional[float] = None,
+    label: str = "broadcast",
+):
+    """Host pytree broadcast over the coordination-service KV store.
+
+    The control plane deliberately does NOT ride device collectives:
+    a `broadcast_one_to_all` whose peer died blocks inside the runtime,
+    and abandoning it (watchdog) leaves the executable wedged on the
+    LOCAL devices — every subsequent local program queues behind it
+    forever, so the survivors could never finish the iteration. The
+    distributed KV service (the same channel `jax.distributed` uses for
+    bootstrap) gives bounded `blocking_key_value_get` calls with no
+    device involvement: a dead peer costs one timeout, nothing more.
+    This is also the most literal analogue of the reference's
+    parameter-server fetches (placement.py:141-148) — the coordinator
+    plays the PS. The whole pytree is fused into one byte blob (chunked
+    under the gRPC message cap), one KV round per variable set, exactly
+    the batching the reference applies.
+
+    Sequence numbers align across processes because every process calls
+    this function in the same deterministic program order; sources GC
+    their own keys `_KV_GC_LAG` sequences later. A fetch failure
+    (timeout / dead coordinator) raises `PeerLostError`.
     """
-    from jax.experimental import multihost_utils
-
+    faults.trip("collective.entry")
+    seq = _broadcast_seq[0]
+    _broadcast_seq[0] += 1
+    client = _kv_client()
+    if client is None:  # single process: the local payload IS the value
+        return payload
     leaves, treedef = jax.tree_util.tree_flatten(payload)
     if not leaves:
         return payload
     arrs = [np.asarray(leaf) for leaf in leaves]
-    blob = np.frombuffer(
-        b"".join(a.tobytes() for a in arrs), dtype=np.uint8
-    )
-    # The broadcast may return a widened integer dtype (psum accumulator);
-    # the byte VALUES are intact, so narrow back before byte-slicing.
-    out = np.asarray(
-        multihost_utils.broadcast_one_to_all(blob, is_source=is_source)
-    ).astype(np.uint8, copy=False)
+    prefix = "adanet/bcast/%d" % seq
+    if is_source:
+        blob = b"".join(a.tobytes() for a in arrs)
+        nchunks = max(1, -(-len(blob) // _KV_CHUNK_BYTES))
+        keys = []
+        for i in range(nchunks):
+            key = "%s/%d" % (prefix, i)
+            client.key_value_set_bytes(
+                key, blob[i * _KV_CHUNK_BYTES : (i + 1) * _KV_CHUNK_BYTES]
+            )
+            keys.append(key)
+        client.key_value_set("%s/n" % prefix, str(nchunks))
+        keys.append("%s/n" % prefix)
+        _kv_keys_set.append((seq, keys, len(blob)))
+        _kv_bytes_retained[0] += len(blob)
+        min_lag, budget = _kv_gc_limits()
+        while _kv_keys_set and (
+            _kv_keys_set[0][0] <= seq - _KV_GC_LAG
+            or (
+                _kv_bytes_retained[0] > budget
+                and _kv_keys_set[0][0] <= seq - min_lag
+            )
+        ):
+            _, stale, nbytes = _kv_keys_set.pop(0)
+            _kv_bytes_retained[0] -= nbytes
+            for key in stale:
+                try:
+                    client.key_value_delete(key)
+                except Exception:  # GC is best-effort
+                    pass
+        return payload
+    if timeout_secs is None:
+        timeout_secs = collective_timeout_secs()
+    if timeout_secs is None:
+        # Deadline disabled (ADANET_COLLECTIVE_TIMEOUT_SECS=0): the KV
+        # API still needs a bound; a week is "no deadline" in practice.
+        timeout_secs = 7 * 24 * 3600.0
+    timeout_ms = max(1000, int(timeout_secs * 1000))
+    try:
+        nchunks = int(
+            client.blocking_key_value_get("%s/n" % prefix, timeout_ms)
+        )
+        blob = b"".join(
+            client.blocking_key_value_get_bytes(
+                "%s/%d" % (prefix, i), timeout_ms
+            )
+            for i in range(nchunks)
+        )
+    except Exception as exc:
+        raise PeerLostError(
+            label,
+            timeout_secs=timeout_secs,
+            detail="KV broadcast fetch failed (dead source or "
+            "coordinator): %s" % exc,
+        ) from exc
     rebuilt = []
     offset = 0
     for a in arrs:
-        chunk = out[offset : offset + a.nbytes]
-        rebuilt.append(
-            np.frombuffer(chunk.tobytes(), dtype=a.dtype).reshape(a.shape)
-        )
+        chunk = blob[offset : offset + a.nbytes]
+        rebuilt.append(np.frombuffer(chunk, dtype=a.dtype).reshape(a.shape))
         offset += a.nbytes
     return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
+_flag_seq = [0]
+#: Flag values are a handful of bytes; a short fixed lag is plenty.
+_FLAG_GC_LAG = 8
+
+
+def allgather_host_flag(
+    value: int,
+    timeout_secs: Optional[float] = None,
+    label: str = "flag agreement",
+) -> np.ndarray:
+    """All-process agreement on a small host integer over the KV store.
+
+    The device-free analogue of `multihost_utils.process_allgather` for
+    control-plane flags (the stop agreement, the restore-failure
+    agreement): every process writes its value under a shared sequence
+    number and reads every peer's, each get bounded by the collective
+    deadline. Routing flags through the KV store instead of a device
+    collective keeps the hang-proofing contract — a dead peer costs one
+    `PeerLostError` within the deadline, and abandoning a KV wait can
+    never wedge the survivors' local runtime (see `_broadcast_tree`).
+
+    Call sites must be deterministic program points reached by every
+    process (sequence numbers align), exactly like `_broadcast_tree`.
+    Returns the int32 vector of all processes' values (length 1 when
+    single-process / no coordination service).
+    """
+    client = _kv_client()
+    count = jax.process_count()
+    if client is None or count == 1:
+        return np.asarray([int(value)], np.int32)
+    seq = _flag_seq[0]
+    _flag_seq[0] += 1
+    if timeout_secs is None:
+        timeout_secs = collective_timeout_secs()
+    if timeout_secs is None:
+        timeout_secs = 7 * 24 * 3600.0
+    timeout_ms = max(1000, int(timeout_secs * 1000))
+    me = jax.process_index()
+    client.key_value_set("adanet/flag/%d/%d" % (seq, me), str(int(value)))
+    try:
+        flags = [
+            int(
+                client.blocking_key_value_get(
+                    "adanet/flag/%d/%d" % (seq, p), timeout_ms
+                )
+            )
+            for p in range(count)
+        ]
+    except Exception as exc:
+        raise PeerLostError(
+            label,
+            timeout_secs=timeout_secs,
+            detail="KV flag fetch failed (dead peer or coordinator): %s"
+            % exc,
+        ) from exc
+    if seq >= _FLAG_GC_LAG:
+        try:  # each process GCs its own stale key; best-effort
+            client.key_value_delete(
+                "adanet/flag/%d/%d" % (seq - _FLAG_GC_LAG, me)
+            )
+        except Exception:
+            pass
+    return np.asarray(flags, np.int32)
 
 
 def _fetch_replicated(tree):
@@ -197,6 +392,16 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
         self._host_template: Optional[IterationState] = None
         self._synced_losses: Dict[str, np.ndarray] = {}
         self._last_local_losses: Dict[str, np.ndarray] = {}
+        # Hang-proofing: every host-level DCN collective is bounded by
+        # this deadline (docs/robustness.md). When a rendezvous expires —
+        # or its transport dies — the source process is declared lost,
+        # its groups' candidates are quarantined, and ALL further
+        # collectives are skipped (the dead transport would hang each
+        # one): the iteration finishes with the survivors' local data.
+        self._collective_timeout = collective_timeout_secs()
+        self._lost_processes: set = set()
+        self._dead_groups: set = set()
+        self._peer_lost_error: Optional[PeerLostError] = None
 
     # ------------------------------------------------------------- topology
 
@@ -351,10 +556,81 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
 
         return jax.tree_util.tree_map(put, batch)
 
+    # ---------------------------------------------------------- peer loss
+
+    @property
+    def lost_peers(self) -> set:
+        """Process indices declared lost (empty in a healthy run)."""
+        return set(self._lost_processes)
+
+    @property
+    def peer_lost_error(self) -> Optional[PeerLostError]:
+        """The first peer-loss diagnosis (None in a healthy run)."""
+        return self._peer_lost_error
+
+    def _on_peer_lost(self, exc: PeerLostError) -> None:
+        """Quarantines everything a lost peer owned; disables collectives.
+
+        Survivable when every group spanning a lost process is a
+        subnetwork group (its candidates die, survivors continue). NOT
+        survivable when the ensemble group itself spans a lost process:
+        selection state lives there, so the error propagates (the
+        estimator checkpoints and stops, resumable after restart).
+        """
+        src = exc.source_process
+        if src is None or src in self._lost_processes:
+            return
+        self._lost_processes.add(src)
+        if self._peer_lost_error is None:
+            self._peer_lost_error = exc
+        _LOG.error(
+            "Declared process %d LOST (%s); skipping all further "
+            "collectives and continuing with survivors.",
+            src,
+            exc,
+        )
+        for g, owners in enumerate(self._owners):
+            lost_owner = bool(set(owners) & self._lost_processes)
+            if g == 0:
+                if lost_owner:
+                    # The ensemble group spans a dead process: mixture-
+                    # weight state cannot advance or gather. Unsurvivable.
+                    raise PeerLostError(
+                        "ensemble group",
+                        source_process=src,
+                        detail="the ensemble submesh spans a lost "
+                        "process; checkpoint and restart to re-form "
+                        "the cluster",
+                    ) from exc
+                continue
+            # With collectives disabled, a group this process does not
+            # own can never deliver its state again — even if its owner
+            # is alive. Selecting (let alone freezing) such a candidate
+            # would persist the zeros gather template as parameters, so
+            # EVERY unreachable group is quarantined, not just the lost
+            # owners' (the blamed process may not even be the dead one).
+            if not lost_owner and self._owns(g):
+                continue
+            self._dead_groups.add(g)
+            spec = self.iteration.subnetwork_specs[g - 1]
+            if spec.name not in self._dead_subnetworks:
+                reason = (
+                    exc
+                    if lost_owner
+                    else PeerLostError(
+                        "group %d unreachable" % g,
+                        source_process=owners[0],
+                        detail="collectives disabled after peer loss; "
+                        "this group's state cannot reach this process",
+                    )
+                )
+                self._mark_subnetwork_dead(spec.name, reason)
+
     # -------------------------------------------------------------- syncing
 
     def _broadcast_from_group(
-        self, group_index: int, payload_if_owner, template_if_not
+        self, group_index: int, payload_if_owner, template_if_not,
+        label: str = "broadcast",
     ):
         """Broadcasts a host pytree from the group's first owner to all
         processes (the DCN leg of the PS-fetch analogue).
@@ -362,7 +638,14 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
         `payload_if_owner` is evaluated only on owning processes;
         `template_if_not` builds a zeros pytree of the SAME structure on
         the others (broadcast is a psum of source data with zeros, so the
-        structures must match exactly). Both are zero-arg callables."""
+        structures must match exactly). Both are zero-arg callables.
+
+        Bounded by the collective watchdog: when the rendezvous hangs or
+        its transport dies, the source is declared lost and the caller
+        receives its LOCAL data (owners) or the zeros template
+        (non-owners) — with the dead groups' candidates quarantined.
+        After any peer loss, collectives are skipped outright.
+        """
         src = self._owners[group_index][0]
         if self._process_count == 1:
             return payload_if_owner()
@@ -372,9 +655,29 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             payload = jax.tree_util.tree_map(
                 np.zeros_like, template_if_not()
             )
-        return _broadcast_tree(
-            payload, is_source=(self._process_index == src)
-        )
+        if self._lost_processes:
+            return payload
+        try:
+            # The KV transport self-bounds its fetches; the outer
+            # watchdog only covers a wedged gRPC channel (grace on top).
+            return call_with_deadline(
+                lambda: _broadcast_tree(
+                    payload,
+                    is_source=(self._process_index == src),
+                    timeout_secs=self._collective_timeout,
+                    label=label,
+                ),
+                None
+                if self._collective_timeout is None
+                else self._collective_timeout + 10.0,
+                label,
+                source_process=src,
+            )
+        except PeerLostError as exc:
+            if exc.source_process is None:
+                exc.source_process = src
+            self._on_peer_lost(exc)
+            return payload
 
     def _maybe_sync_members(self, new_subnetworks) -> None:
         """Member-parameter sync across processes.
@@ -399,7 +702,12 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             def local_payload(n=name):
                 # Losses stay device arrays until this sync boundary, so
                 # the per-step dispatch loop never blocks on a host fetch
-                # (the base executor's async-dispatch contract).
+                # (the base executor's async-dispatch contract). The
+                # dead flag rides along so every process converges on
+                # the same quarantine set by the next sync boundary (an
+                # owner whose candidate faulted keeps broadcasting its
+                # frozen state — the collective schedule must stay
+                # aligned across processes — but flags it dead).
                 st = new_subnetworks[n]
                 loss = self._last_local_losses.get(n)
                 loss = (
@@ -407,17 +715,31 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
                     if loss is None
                     else np.asarray(_fetch_replicated(loss), np.float32)
                 )
-                return (_fetch_replicated(st.variables), loss)
+                dead = np.asarray(
+                    1.0 if n in self._dead_subnetworks else 0.0,
+                    np.float32,
+                )
+                return (_fetch_replicated(st.variables), loss, dead)
 
             def template(n=name):
                 return (
                     self._host_template.subnetworks[n].variables,
                     np.zeros((), np.float32),
+                    np.zeros((), np.float32),
                 )
 
-            host_vars, loss = self._broadcast_from_group(
-                g, local_payload, template
+            host_vars, loss, dead_flag = self._broadcast_from_group(
+                g, local_payload, template, label="member sync %s" % name
             )
+            if float(dead_flag) > 0.5 and name not in self._dead_subnetworks:
+                self._dead_subnetworks[name] = (
+                    "quarantined by owning process (synced flag)"
+                )
+                _LOG.error(
+                    "Candidate subnetwork %r quarantined by its owning "
+                    "process.",
+                    name,
+                )
             if not self._owns(g):
                 self._synced_losses["subnetwork_loss/%s" % name] = loss
             if self.owns_ensemble:
@@ -477,26 +799,32 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             g = 1 + i
             if not self._owns(g):
                 continue
-            sub_batch = self._group_batch(
-                extra_batches.get(spec.name, (features, labels)), g
-            )
+            if spec.name in self._dead_subnetworks or g in self._dead_groups:
+                continue  # quarantined: state stays at its last good step
             rng_i = jax.random.fold_in(step_rng, i)
-            if self._needs_context[spec.name]:
-                new_st, loss, extra = self._sub_steps[spec.name](
-                    state.subnetworks[spec.name],
-                    self._sub_frozen[spec.name],
-                    self._sub_prev_params[spec.name],
-                    sub_batch[0],
-                    sub_batch[1],
-                    rng_i,
+            try:
+                sub_batch = self._group_batch(
+                    extra_batches.get(spec.name, (features, labels)), g
                 )
-            else:
-                new_st, loss, extra = self._sub_steps[spec.name](
-                    state.subnetworks[spec.name],
-                    sub_batch[0],
-                    sub_batch[1],
-                    rng_i,
-                )
+                if self._needs_context[spec.name]:
+                    new_st, loss, extra = self._sub_steps[spec.name](
+                        state.subnetworks[spec.name],
+                        self._sub_frozen[spec.name],
+                        self._sub_prev_params[spec.name],
+                        sub_batch[0],
+                        sub_batch[1],
+                        rng_i,
+                    )
+                else:
+                    new_st, loss, extra = self._sub_steps[spec.name](
+                        state.subnetworks[spec.name],
+                        sub_batch[0],
+                        sub_batch[1],
+                        rng_i,
+                    )
+            except CANDIDATE_FAULTS as exc:
+                self._mark_subnetwork_dead(spec.name, exc)
+                continue
             new_subnetworks[spec.name] = new_st
             # Keep the loss a device array: the host fetch happens only at
             # sync boundaries, preserving async dispatch across groups.
@@ -558,24 +886,30 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
             g = 1 + i
             if not self._owns(g):
                 continue
-            sub_batch = self._group_batch(
-                (features, labels), g, stacked=True
-            )
+            if spec.name in self._dead_subnetworks or g in self._dead_groups:
+                continue  # quarantined: state stays at its last good step
             keys_i = jax.vmap(
                 lambda key, index=i: jax.random.fold_in(key, index)
             )(step_rngs)
-            if self._needs_context[spec.name]:
-                new_st, loss, extra = self._sub_multi_steps[spec.name](
-                    state.subnetworks[spec.name],
-                    self._sub_frozen[spec.name],
-                    self._sub_prev_params[spec.name],
-                    sub_batch,
-                    keys_i,
+            try:
+                sub_batch = self._group_batch(
+                    (features, labels), g, stacked=True
                 )
-            else:
-                new_st, loss, extra = self._sub_multi_steps[spec.name](
-                    state.subnetworks[spec.name], sub_batch, keys_i
-                )
+                if self._needs_context[spec.name]:
+                    new_st, loss, extra = self._sub_multi_steps[spec.name](
+                        state.subnetworks[spec.name],
+                        self._sub_frozen[spec.name],
+                        self._sub_prev_params[spec.name],
+                        sub_batch,
+                        keys_i,
+                    )
+                else:
+                    new_st, loss, extra = self._sub_multi_steps[spec.name](
+                        state.subnetworks[spec.name], sub_batch, keys_i
+                    )
+            except CANDIDATE_FAULTS as exc:
+                self._mark_subnetwork_dead(spec.name, exc)
+                continue
             new_subnetworks[spec.name] = new_st
             # Keep the loss a device array: the host fetch happens only at
             # sync boundaries, preserving async dispatch across groups.
@@ -643,7 +977,14 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
         """Full state to host on EVERY process (collective): subnetwork
         states broadcast from their group owners, ensemble/candidate state
         from the ensemble group — bookkeeping then proceeds replicated, as
-        the reference forces ReplicationStrategy outside training."""
+        the reference forces ReplicationStrategy outside training.
+
+        Every leg rides the watchdog-guarded broadcast: with a lost peer
+        the collectives are skipped, non-owned pieces stay zeros
+        templates (their candidates carry `ema_count == 0`, hence an
+        infinite selection EMA — never selectable), and bookkeeping
+        proceeds from the survivors' local data. Quarantine flags ride
+        along so every process applies the same dead set at selection."""
         if self._host_template is None:
             return jax.device_get(state)
 
@@ -651,43 +992,49 @@ class MultiHostRoundRobinExecutor(RoundRobinExecutor):
         for i, spec in enumerate(self.iteration.subnetwork_specs):
             g = 1 + i
             name = spec.name
-            src = self._owners[g][0]
-            if self._process_count == 1:
-                sub_states[name] = _fetch_replicated(
-                    state.subnetworks[name]
-                )
-                continue
-            if self._owns(g):
-                payload = _fetch_replicated(state.subnetworks[name])
-            else:
-                payload = jax.tree_util.tree_map(
-                    np.zeros_like, self._host_template.subnetworks[name]
-                )
-            sub_states[name] = _broadcast_tree(
-                payload, is_source=(self._process_index == src)
-            )
 
-        if self._process_count == 1:
-            ens = _fetch_replicated(state.ensembles)
-            cands = _fetch_replicated(state.candidates)
-        else:
-            if self.owns_ensemble:
-                payload = (
-                    _fetch_replicated(state.ensembles),
-                    _fetch_replicated(state.candidates),
-                )
-            else:
-                payload = jax.tree_util.tree_map(
-                    np.zeros_like,
-                    (
-                        self._host_template.ensembles,
-                        self._host_template.candidates,
+            def local(n=name):
+                return (
+                    _fetch_replicated(state.subnetworks[n]),
+                    np.asarray(
+                        1.0 if n in self._dead_subnetworks else 0.0,
+                        np.float32,
                     ),
                 )
-            ens, cands = _broadcast_tree(
-                payload,
-                is_source=(self._process_index == self._owners[0][0]),
+
+            def template(n=name):
+                return (
+                    self._host_template.subnetworks[n],
+                    np.zeros((), np.float32),
+                )
+
+            sub_state, dead_flag = self._broadcast_from_group(
+                g, local, template, label="gather %s" % name
             )
+            if (
+                float(dead_flag) > 0.5
+                and name not in self._dead_subnetworks
+            ):
+                self._dead_subnetworks[name] = (
+                    "quarantined by owning process (gather flag)"
+                )
+            sub_states[name] = sub_state
+
+        def ens_local():
+            return (
+                _fetch_replicated(state.ensembles),
+                _fetch_replicated(state.candidates),
+            )
+
+        def ens_template():
+            return (
+                self._host_template.ensembles,
+                self._host_template.candidates,
+            )
+
+        ens, cands = self._broadcast_from_group(
+            0, ens_local, ens_template, label="gather ensemble"
+        )
 
         # Frozen members never train: every process holds the identical
         # host copy it initialized with.

@@ -155,8 +155,11 @@ class _DebiasedBatchNorm(nn.Module):
 
     Parameters are named scale/bias like `nn.BatchNorm`; statistics live
     in the standard `batch_stats` collection (mean/var + the update
-    `count` — NASNet checkpoints written before round 5, which lack the
-    count leaf, are not strict-restorable; none ship in-repo). Statistics
+    `count`). NASNet checkpoints written before round 5 lack the count
+    leaf; strict restore (`core/checkpoint.py:restore_pytree`) migrates
+    them in flight, injecting `legacy_batch_stats_count()` — the
+    statistics were accumulated under the fixed long-run decay, so
+    "converged" is the faithful reading (ADVICE r5). Statistics
     and the normalization itself are float32 regardless of the compute
     dtype (the TPU-first bf16 rule: bf16 matmuls, f32 statistics).
     """
@@ -206,6 +209,22 @@ class _DebiasedBatchNorm(nn.Module):
             var = jnp.where(trained, var_ema.value, 1.0)
         y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
         return y * scale + bias
+
+
+def legacy_batch_stats_count() -> float:
+    """The `count` injected when restoring a pre-round-5 checkpoint.
+
+    The smallest count at which the warmup schedule
+    `m_t = min(momentum, count / (count + warmup))` has converged to the
+    fixed `momentum` those legacy statistics were actually accumulated
+    under (~33k steps at the defaults): restored models keep the exact
+    eval-mode behavior they were trained with, and further training
+    updates at the long-run decay instead of restarting the warmup.
+    Consumed by `core/checkpoint.py`'s restore shim.
+    """
+    momentum = _DebiasedBatchNorm.momentum
+    warmup = _DebiasedBatchNorm.warmup
+    return warmup * momentum / (1.0 - momentum)
 
 
 def _batch_norm(x, training: bool, name: str):

@@ -193,7 +193,17 @@ def _tpu_lowering_ok(x, dw, pw, stride: int) -> bool:
     validate (and a CPU-targeted trace on a TPU host must not pay TPU
     compiles). LOCAL devices only — under multi-host SPMD every process
     validates against its own addressable chip, so the verdict (and
-    therefore the traced branch) is identical across processes."""
+    therefore the traced branch) is identical across processes.
+
+    CAVEAT (ADVICE r5): the validation happens at the caller's
+    TRACE-time shapes, which under jit + SPMD partitioning are the
+    GLOBAL array shapes; GSPMD then lowers the kernel at PER-SHARD
+    shapes. The guard is therefore exact only for unpartitioned calls
+    (replicated or fully local operands): a partitioned call can pass
+    validation here yet fail the real compile, or be rejected for a
+    global shape whose shards would have lowered fine. Callers
+    partitioning the conv operands should validate the shard shape
+    (global divided by the mesh partitioning) instead."""
     try:
         if jax.default_backend() != "tpu":
             return True

@@ -1,0 +1,82 @@
+"""Robustness subsystem: fault injection, self-healing checkpoints,
+hang-proof multi-host coordination.
+
+The AdaNet search loop is a long-running, stateful, multi-process
+workload; at production scale it must survive preemption, disk
+corruption, and dead peers (ROADMAP north star). This package holds the
+host-side machinery the rest of the framework is instrumented with:
+
+- `faults`: a deterministic, config/env-driven registry of named fault
+  sites (checkpoint write, manifest read, collective entry, compile-cache
+  read, data pull). Tests and chaos runs arm a site by hit count; the
+  instrumented seams in `core/checkpoint.py`, `core/estimator.py`,
+  `core/compile_cache.py`, and `distributed/multihost.py` trip it.
+- `retry`: bounded, deterministic retry-with-backoff for transient
+  filesystem / data-source / compile-cache errors.
+- `watchdog`: deadlines around host-level DCN collectives
+  (`PeerLostError` within seconds instead of a silent multi-minute hang)
+  plus the chief heartbeat workers use to detect a dead chief.
+- `integrity`: checkpoint verification (per-payload SHA-256 digests, the
+  manifest generation chain), quarantine of corrupt files, and automatic
+  rollback to the newest intact generation — the engine behind
+  `tools/ckpt_fsck.py` and the heal pass `Estimator.train` runs before
+  restoring.
+
+See docs/robustness.md for the full contract and tuning knobs.
+"""
+
+from adanet_tpu.robustness.faults import (  # noqa: F401
+    FAULT_SITES,
+    InjectedFault,
+    InjectedTransientError,
+    arm,
+    armed,
+    disarm,
+    trip,
+)
+from adanet_tpu.robustness.retry import (  # noqa: F401
+    is_transient,
+    with_retries,
+)
+from adanet_tpu.robustness.watchdog import (  # noqa: F401
+    HeartbeatWriter,
+    PeerLostError,
+    call_with_deadline,
+    collective_timeout_secs,
+    heartbeat_age,
+)
+
+def __getattr__(name):
+    # `integrity` builds on core/checkpoint.py, which itself imports the
+    # fault registry from this package: loading it lazily keeps the
+    # package import acyclic (PEP 562).
+    if name in ("FsckReport", "fsck", "integrity"):
+        import importlib
+
+        integrity = importlib.import_module(
+            "adanet_tpu.robustness.integrity"
+        )
+        if name == "integrity":
+            return integrity
+        return getattr(integrity, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "FAULT_SITES",
+    "InjectedFault",
+    "InjectedTransientError",
+    "arm",
+    "armed",
+    "disarm",
+    "trip",
+    "FsckReport",
+    "fsck",
+    "is_transient",
+    "with_retries",
+    "HeartbeatWriter",
+    "PeerLostError",
+    "call_with_deadline",
+    "collective_timeout_secs",
+    "heartbeat_age",
+]

@@ -1,0 +1,321 @@
+"""Checkpoint verification, quarantine, and rollback (`ckpt_fsck`).
+
+The self-healing half of the checkpoint contract (docs/robustness.md):
+`fsck` walks a model dir's durable artifacts — the manifest chain, the
+per-iteration `architecture-<t>.json` + `frozen-<t>.msgpack` pairs, the
+mid-iteration `ckpt-<step>.msgpack`, and retained
+`iteration-final-<t>.msgpack` states — verifying each against its
+SHA-256 digest (or, for legacy files without one, a decode check). A
+corrupt file degrades to "resume from the previous generation":
+
+- corrupt mid-iteration state → quarantined (`*.corrupt`); the run
+  restarts the CURRENT iteration from its first step (global step rolls
+  back to the previous iteration's end);
+- corrupt frozen/architecture at iteration t → quarantined; the manifest
+  rolls back to iteration t (iterations 0..t-1 stay frozen; t retrains),
+  and now-orphaned later-iteration artifacts are retired (`*.stale`) so
+  a future manifest reconstruction can never resurrect a mixed chain;
+- orphaned `ckpt-*` payloads that fail verification (the torn leftovers
+  of a crash mid-write) → quarantined.
+
+`Estimator.train` runs `fsck(repair=is_chief)` before restoring, so a
+torn or bit-rotted file costs re-training one iteration, never a crash
+or silent garbage. `tools/ckpt_fsck.py` is the operator CLI over the
+same engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+from typing import List, Optional
+
+from adanet_tpu.core import checkpoint as ckpt
+
+_LOG = logging.getLogger("adanet_tpu")
+
+STALE_SUFFIX = ".stale"
+
+
+@dataclasses.dataclass
+class FsckReport:
+    """The outcome of one verification/heal pass."""
+
+    ok: bool = True
+    fresh: bool = False
+    issues: List[str] = dataclasses.field(default_factory=list)
+    quarantined: List[str] = dataclasses.field(default_factory=list)
+    retired: List[str] = dataclasses.field(default_factory=list)
+    rolled_back_to_iteration: Optional[int] = None
+    rolled_back_global_step: Optional[int] = None
+    manifest_rewritten: bool = False
+    info: Optional[ckpt.CheckpointInfo] = None
+
+    def to_json(self) -> dict:
+        obj = dataclasses.asdict(self)
+        info = obj.pop("info")
+        if info is not None:
+            obj["iteration_number"] = info["iteration_number"]
+            obj["global_step"] = info["global_step"]
+            obj["generation"] = info["generation"]
+        return obj
+
+
+def _payload_intact(
+    model_dir: str, filename: str, info: ckpt.CheckpointInfo
+) -> bool:
+    """Digest verdict, falling back to a decode check for legacy files."""
+    verdict = ckpt.verify_file(
+        model_dir, filename, expected=info.digests.get(filename)
+    )
+    if verdict is not None:
+        return verdict
+    # Legacy payload without a recorded digest: decoding is the only
+    # structural check available (catches truncation, not bit flips in
+    # valid msgpack). OSError covers a file the chief's concurrent
+    # repair pass just quarantined out from under this process.
+    try:
+        ckpt.restore_payload(model_dir, filename)
+        return True
+    except (ckpt.CheckpointCorruptionError, OSError):
+        return False
+
+
+def _arch_global_step(model_dir: str, iteration: int) -> Optional[int]:
+    try:
+        with open(
+            os.path.join(
+                model_dir, ckpt.architecture_filename(iteration)
+            )
+        ) as f:
+            return int(json.load(f).get("global_step", 0))
+    except (OSError, ValueError):
+        return None
+
+
+def end_step_of(info: ckpt.CheckpointInfo, model_dir: str, t: int) -> int:
+    """Global step at the end of completed iteration t-1 (0 for t == 0).
+
+    Public: the estimator's restore-time corruption handler applies the
+    same rollback rule fsck does.
+    """
+    if t <= 0:
+        return 0
+    for entry in reversed(info.history):
+        if int(entry.get("iteration_number", -1)) == t - 1:
+            return int(entry.get("global_step", 0))
+    step = _arch_global_step(model_dir, t - 1)
+    return step if step is not None else 0
+
+
+def _retire(
+    model_dir: str,
+    filename: str,
+    report: FsckReport,
+    repair: bool,
+    reason: str = "orphaned by rollback",
+) -> None:
+    """Renames an intact-but-orphaned artifact to `<name>.stale`."""
+    path = os.path.join(model_dir, filename)
+    if not os.path.exists(path):
+        return
+    report.issues.append("%s: %s" % (reason, filename))
+    if not repair:
+        return
+    target = filename + STALE_SUFFIX
+    n = 0
+    while os.path.exists(os.path.join(model_dir, target)):
+        n += 1
+        target = "%s%s.%d" % (filename, STALE_SUFFIX, n)
+    try:
+        os.replace(path, os.path.join(model_dir, target))
+    except FileNotFoundError:
+        return  # a concurrent heal won the rename
+    try:
+        os.replace(
+            ckpt.digest_path(model_dir, filename),
+            os.path.join(model_dir, target + ckpt.DIGEST_SUFFIX),
+        )
+    except OSError:
+        pass
+    report.retired.append(target)
+
+
+def _quarantine(
+    model_dir: str, filename: str, report: FsckReport, repair: bool
+) -> None:
+    if repair:
+        name = ckpt.quarantine_file(model_dir, filename)
+        if name:
+            report.quarantined.append(name)
+    else:
+        report.issues.append("would quarantine: %s" % filename)
+
+
+def fsck(model_dir: str, repair: bool = False) -> FsckReport:
+    """Verifies a model dir; with `repair`, quarantines and rolls back.
+
+    Deterministic given the dir contents, so every process of a
+    multi-host run computes the same healed `info`; only the chief
+    passes `repair=True` and persists it.
+    """
+    report = FsckReport()
+    # Report-only mode (and non-chief processes) must not mutate the
+    # dir: only the repair pass may quarantine the corrupt main copy.
+    info = ckpt.read_manifest(model_dir, quarantine=repair)
+    if info is None:
+        report.fresh = True
+        return report
+    report.info = info
+    dirty = False
+    main = os.path.join(model_dir, ckpt.MANIFEST)
+    if not os.path.exists(main):
+        # read_manifest recovered from .prev or reconstructed from the
+        # artifact chain (quarantining the corrupt main copy); persist
+        # the recovered state so the next reader takes the fast path.
+        report.issues.append(
+            "main manifest missing/corrupt (recovered from fallback)"
+        )
+        dirty = True
+    elif not repair and not ckpt.manifest_intact(model_dir):
+        # Without repair the corrupt main copy stays in place; report
+        # what the repair pass would do.
+        report.issues.append(
+            "would quarantine: %s (corrupt; recovered from fallback)"
+            % ckpt.MANIFEST
+        )
+        dirty = True
+
+    # ------------------------- completed-iteration chain (frozen + arch)
+    rollback: Optional[int] = None
+    for t in range(info.iteration_number):
+        arch_name = ckpt.architecture_filename(t)
+        frozen_name = ckpt.frozen_filename(t)
+        arch_ok = _arch_global_step(model_dir, t) is not None
+        frozen_ok = os.path.exists(
+            os.path.join(model_dir, frozen_name)
+        ) and _payload_intact(model_dir, frozen_name, info)
+        if arch_ok and frozen_ok:
+            continue
+        rollback = t
+        if not arch_ok:
+            report.issues.append(
+                "architecture chain broken at iteration %d (%s)"
+                % (t, arch_name)
+            )
+            _quarantine(model_dir, arch_name, report, repair)
+        if not frozen_ok:
+            report.issues.append(
+                "frozen payload corrupt/missing at iteration %d (%s)"
+                % (t, frozen_name)
+            )
+            _quarantine(model_dir, frozen_name, report, repair)
+        break
+
+    if rollback is not None:
+        # Retire the now-orphaned artifacts of iterations beyond the
+        # rollback point so no reconstruction can mix two chains.
+        for t in range(rollback, info.iteration_number):
+            for name in (
+                ckpt.architecture_filename(t),
+                ckpt.frozen_filename(t),
+                ckpt.final_state_filename(t),
+            ):
+                # Corrupt files at the break point were quarantined
+                # above (renamed away); whatever still exists here is
+                # intact but belongs to the abandoned chain.
+                _retire(model_dir, name, report, repair)
+        if info.iteration_state_file:
+            # Any mid-iteration state belongs to the rolled-back future.
+            _retire(
+                model_dir, info.iteration_state_file, report, repair
+            )
+            info.iteration_state_file = None
+        info.iteration_number = rollback
+        info.replay_indices = info.replay_indices[:rollback]
+        info.history = [
+            entry
+            for entry in info.history
+            if int(entry.get("iteration_number", -1)) < rollback
+        ]
+        info.global_step = end_step_of(info, model_dir, rollback)
+        report.rolled_back_to_iteration = rollback
+        report.rolled_back_global_step = info.global_step
+        dirty = True
+        _LOG.error(
+            "Checkpoint chain broken at iteration %d: rolled back to "
+            "iteration %d, global step %d (corrupt files quarantined).",
+            rollback,
+            rollback,
+            info.global_step,
+        )
+
+    # ------------------------------------------- mid-iteration state file
+    if info.iteration_state_file:
+        name = info.iteration_state_file
+        if not _payload_intact(model_dir, name, info):
+            report.issues.append(
+                "mid-iteration state corrupt (%s)" % name
+            )
+            _quarantine(model_dir, name, report, repair)
+            info.iteration_state_file = None
+            info.global_step = end_step_of(
+                info, model_dir, info.iteration_number
+            )
+            if report.rolled_back_to_iteration is None:
+                report.rolled_back_to_iteration = info.iteration_number
+            report.rolled_back_global_step = info.global_step
+            dirty = True
+            _LOG.error(
+                "Mid-iteration state %s corrupt: iteration %d restarts "
+                "from global step %d.",
+                name,
+                info.iteration_number,
+                info.global_step,
+            )
+
+    # -------------------------------------------------- orphaned payloads
+    try:
+        entries = sorted(os.listdir(model_dir))
+    except OSError:
+        entries = []
+    for name in entries:
+        if not re.fullmatch(r"ckpt-\d+\.msgpack", name):
+            continue
+        if name == info.iteration_state_file:
+            continue
+        if _payload_intact(model_dir, name, info):
+            # Intact but unreferenced (a crash between the payload write
+            # and the manifest update): retire it so repeated repair
+            # runs converge to a clean verdict instead of flagging the
+            # same file forever.
+            _retire(
+                model_dir, name, report, repair,
+                reason="intact orphan payload",
+            )
+            continue
+        report.issues.append(
+            "orphan payload failed verification (torn write?): %s" % name
+        )
+        _quarantine(model_dir, name, report, repair)
+
+    # Retained per-iteration final states: corruption never blocks the
+    # search (they serve post-hoc eval), but garbage must not be served.
+    for t in range(info.iteration_number):
+        name = ckpt.final_state_filename(t)
+        if os.path.exists(os.path.join(model_dir, name)):
+            if not _payload_intact(model_dir, name, info):
+                report.issues.append(
+                    "retained candidate state corrupt (%s)" % name
+                )
+                _quarantine(model_dir, name, report, repair)
+
+    if dirty and repair:
+        ckpt.write_manifest(model_dir, info)
+        report.manifest_rewritten = True
+    report.ok = not report.issues
+    report.info = info
+    return report

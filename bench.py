@@ -401,20 +401,61 @@ _PROBE_CACHE_TTL_SECS = 600
 
 def _probe_cache_path():
     import hashlib
-    import tempfile
 
-    # Keyed by uid + backend-relevant env: a success under JAX_PLATFORMS=
-    # cpu (or another user's run) must not vouch for a dead TPU tunnel.
+    # Keyed by the backend-relevant env: a success under JAX_PLATFORMS=
+    # cpu must not vouch for a dead TPU tunnel. Lives under a PER-USER
+    # 0700 cache dir, not the shared temp dir: a world-writable marker
+    # path lets another local user pre-create the file (or plant a
+    # symlink) and falsely vouch for a dead backend — reintroducing the
+    # ~45-min dead-tunnel hang the probe exists to prevent (ADVICE r5).
     sig = hashlib.sha1(
         "|".join(
             "%s=%s" % (k, os.environ.get(k, ""))
             for k in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "TPU_NAME")
         ).encode()
     ).hexdigest()[:10]
-    uid = os.getuid() if hasattr(os, "getuid") else 0
-    return os.path.join(
-        tempfile.gettempdir(), "adanet_bench_probe_ok-%s-%s" % (uid, sig)
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
     )
+    directory = os.path.join(base, "adanet_bench")
+    os.makedirs(directory, mode=0o700, exist_ok=True)
+    return os.path.join(directory, "probe_ok-%s" % sig)
+
+
+def _probe_marker_fresh(marker):
+    """mtime freshness, trusting only a regular file we own (no symlink
+    following, no other-uid file — the marker gates a hang-avoidance
+    path, so spoofing it must be impossible)."""
+    import stat
+
+    try:
+        st = os.lstat(marker)
+    except OSError:
+        return False
+    if not stat.S_ISREG(st.st_mode):
+        return False
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        return False
+    return time.time() - st.st_mtime < _PROBE_CACHE_TTL_SECS
+
+
+def _write_probe_marker(marker):
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    try:
+        # O_EXCL|O_NOFOLLOW: never follow a planted symlink, never reuse
+        # a file raced into place between the unlink and the open.
+        fd = os.open(
+            marker,
+            os.O_CREAT | os.O_EXCL | os.O_NOFOLLOW | os.O_WRONLY,
+            0o600,
+        )
+        with os.fdopen(fd, "w") as f:
+            f.write(str(time.time()))
+    except OSError:
+        pass
 
 
 def _probe_backend(timeout_secs=300):
@@ -429,11 +470,8 @@ def _probe_backend(timeout_secs=300):
     cached: a tunnel that just died must re-probe on the next run).
     """
     marker = _probe_cache_path()
-    try:
-        if time.time() - os.path.getmtime(marker) < _PROBE_CACHE_TTL_SECS:
-            return True
-    except OSError:
-        pass
+    if _probe_marker_fresh(marker):
+        return True
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -444,11 +482,7 @@ def _probe_backend(timeout_secs=300):
     except (subprocess.TimeoutExpired, OSError):
         ok = False
     if ok:
-        try:
-            with open(marker, "w") as f:
-                f.write(str(time.time()))
-        except OSError:
-            pass
+        _write_probe_marker(marker)
     return ok
 
 

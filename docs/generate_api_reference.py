@@ -36,6 +36,7 @@ API_MODULES = [
     "adanet_tpu.autoensemble",
     "adanet_tpu.distributed",
     "adanet_tpu.replay",
+    "adanet_tpu.robustness",
     "adanet_tpu.experimental",
     "adanet_tpu.models",
     "adanet_tpu.parallel",

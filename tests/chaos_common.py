@@ -1,0 +1,41 @@
+"""Shared search configuration for the chaos tests and their runners.
+
+Import-side-effect free (no jax config): the runners configure their own
+backends first, the in-process tests ride conftest's. One config shared
+by the torn-write runner (phase A), the multi-host chaos runner
+(phase C), and the parent test's oracle/resume runs, so "rollback and
+resume reaches the same final architecture as an uninterrupted run" is
+a meaningful assertion.
+"""
+
+import optax
+
+import adanet_tpu
+from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+from adanet_tpu.subnetwork import SimpleGenerator
+
+from helpers import DNNBuilder
+from multihost_rr_runner import full_batches  # noqa: F401  (re-export)
+
+
+def build_estimator(model_dir, **kwargs):
+    defaults = dict(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("a", 1), DNNBuilder("b", 2)]
+        ),
+        max_iteration_steps=6,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        max_iterations=2,
+        model_dir=model_dir,
+        log_every_steps=0,
+        save_checkpoint_steps=2,
+    )
+    defaults.update(kwargs)
+    return adanet_tpu.Estimator(**defaults)
+
+
+def input_fn():
+    return iter(full_batches())

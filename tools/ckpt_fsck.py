@@ -1,0 +1,86 @@
+"""Checkpoint fsck: verify and repair an AdaNet model directory.
+
+Operator CLI over `adanet_tpu.robustness.integrity.fsck` (the same
+engine `Estimator.train` runs before restoring). Verifies every durable
+artifact — the manifest chain, per-iteration architecture + frozen
+payload pairs, the mid-iteration state, retained candidate states —
+against the recorded SHA-256 digests, and with `--repair` quarantines
+corrupt files (`*.corrupt`), retires artifacts orphaned by a rollback
+(`*.stale`), and rewrites the manifest at the newest intact generation.
+
+Usage:
+    python -m tools.ckpt_fsck MODEL_DIR            # verify, report
+    python -m tools.ckpt_fsck MODEL_DIR --repair   # quarantine + roll back
+    python -m tools.ckpt_fsck MODEL_DIR --json     # machine-readable
+
+Exit status: 0 when the dir is clean (or was repaired), 1 when issues
+were found and --repair was not given, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ckpt_fsck", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument("model_dir", help="AdaNet model directory")
+    parser.add_argument(
+        "--repair",
+        action="store_true",
+        help="quarantine corrupt files and roll the manifest back to the "
+        "newest intact generation",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    from adanet_tpu.robustness import integrity
+
+    report = integrity.fsck(args.model_dir, repair=args.repair)
+
+    if args.json:
+        print(json.dumps(report.to_json(), sort_keys=True))
+    else:
+        if report.fresh:
+            print("fresh model dir (no checkpoint manifest): nothing to do")
+        elif report.ok:
+            info = report.info
+            print(
+                "clean: iteration %d, global step %d, generation %d"
+                % (
+                    info.iteration_number,
+                    info.global_step,
+                    info.generation,
+                )
+            )
+        for issue in report.issues:
+            print("ISSUE: %s" % issue)
+        for name in report.quarantined:
+            print("quarantined: %s" % name)
+        for name in report.retired:
+            print("retired: %s" % name)
+        if report.rolled_back_to_iteration is not None:
+            print(
+                "rolled back to iteration %d (global step %d)%s"
+                % (
+                    report.rolled_back_to_iteration,
+                    report.rolled_back_global_step,
+                    "" if report.manifest_rewritten else " [dry run]",
+                )
+            )
+        if report.manifest_rewritten:
+            print("manifest rewritten")
+
+    if report.ok or report.fresh:
+        return 0
+    return 0 if args.repair else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

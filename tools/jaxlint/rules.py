@@ -678,6 +678,13 @@ class HostModuleJnpRule(Rule):
         "core/timer.py",
         "distributed/coordination.py",
         "replay/__init__.py",
+        # The robustness subsystem runs between device steps by
+        # construction (fault registry, retries, watchdogs, fsck).
+        "robustness/faults.py",
+        "robustness/retry.py",
+        "robustness/watchdog.py",
+        "robustness/integrity.py",
+        "tools/ckpt_fsck.py",
     )
 
     def check(self, ctx: FileContext) -> List[Finding]:

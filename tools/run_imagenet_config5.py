@@ -62,10 +62,18 @@ class _StepLogCapture(logging.Handler):
 
     def __init__(self):
         super().__init__()
-        self.records = []  # (wall_time, step, {candidate: ema})
+        self.records = []  # (wall_time, iteration, step, {candidate: ema})
 
     def emit(self, record):
-        if "adanet_loss EMAs" in record.msg:
+        # Guarded against foreign records on the same logger: msg may be
+        # a non-str object, and the estimator's log arity could change —
+        # a handler must never raise (ADVICE r5).
+        if (
+            isinstance(record.msg, str)
+            and "adanet_loss EMAs" in record.msg
+            and isinstance(record.args, tuple)
+            and len(record.args) == 4
+        ):
             t, step, total, emas = record.args
             self.records.append(
                 (time.time(), int(t), int(step), dict(emas))

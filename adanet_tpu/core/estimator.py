@@ -59,7 +59,10 @@ from adanet_tpu.distributed.mesh import (
     global_batch,
     replicate_state,
 )
-from adanet_tpu.distributed.placement import RoundRobinStrategy
+from adanet_tpu.distributed.placement import (
+    ElasticWorkQueueStrategy,
+    RoundRobinStrategy,
+)
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
 from adanet_tpu.robustness import faults as faults_lib
@@ -106,6 +109,102 @@ def _same_shapes(batches) -> bool:
         if treedef != first_def or leaves != first_leaves:
             return False
     return True
+
+
+class _BatchLog:
+    """Deterministic absolute-index access to a training stream.
+
+    The elastic scheduler's data contract: the batch for global step g
+    is a pure function of g, so a work unit re-issued to a survivor (or
+    re-executed after a restart) replays the exact batches its first
+    execution consumed. Backed by the usual `input_fn` iterator —
+    re-invoked on exhaustion, exactly like `Estimator._next_batch` — with
+    a cache of the indices the current iteration may still re-issue
+    (`forget_below` trims it at iteration boundaries).
+    """
+
+    def __init__(self, make_iter, check=None, close_iter=None):
+        self._make_iter = make_iter
+        self._check = check
+        self._close_iter = close_iter
+        self._iter = None
+        self._next_index = 0
+        self._cache: Dict[int, Any] = {}
+
+    def _reset(self):
+        """Releases the live iterator — a long search crosses many epoch
+        boundaries and must not retain a dead prefetcher (and its parked
+        worker thread) per boundary."""
+        if self._iter is not None and self._close_iter is not None:
+            self._close_iter(self._iter)
+        self._iter = None
+
+    def _swap_iter(self):
+        self._reset()
+        self._iter = self._make_iter()
+
+    def batch_at(self, index: int):
+        if index in self._cache:
+            return self._cache[index]
+        if index < self._next_index:
+            # An evicted prefix: restart the stream and replay —
+            # input_fn streams are deterministic from the top, the same
+            # property checkpoint resume already relies on.
+            self._reset()
+            self._next_index = 0
+        while self._next_index <= index:
+            self._cache[self._next_index] = self._pull()
+            self._next_index += 1
+        return self._cache[index]
+
+    def _next_wrapping(self):
+        """One raw pull, re-opening the stream at epoch end."""
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._swap_iter()
+            try:
+                return next(self._iter)
+            except StopIteration:
+                raise ValueError("input_fn yielded no batches.")
+
+    def _pull(self):
+        """The batch at stream position `self._next_index`.
+
+        A transient failure closes the pipeline; the next attempt
+        re-opens it and deterministically replays to the current
+        position (wrap-aware: a position past one epoch re-walks the
+        epochs exactly as the original pulls did). The replay runs
+        INSIDE the bounded retry, so a second hiccup mid-replay consumes
+        the next attempt instead of escaping the loop.
+        """
+        position = self._next_index
+        for attempt in range(3):
+            try:
+                faults_lib.trip("data.pull")
+                if self._iter is None:
+                    self._swap_iter()
+                    for _ in range(position):
+                        self._next_wrapping()
+                batch = self._next_wrapping()
+                if self._check is not None:
+                    self._check(batch)
+                return batch
+            except Exception as exc:
+                if attempt == 2 or not retry_lib.is_transient(exc):
+                    raise
+                _LOG.warning(
+                    "Transient data-source failure in the elastic batch "
+                    "log (attempt %d/3): %s; re-opening the pipeline.",
+                    attempt + 1,
+                    exc,
+                )
+                self._reset()
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def forget_below(self, index: int) -> None:
+        for key in [k for k in self._cache if k < index]:
+            del self._cache[key]
 
 
 class Estimator:
@@ -269,6 +368,13 @@ class Estimator:
         # §1 L5). None = replicated training (the reference default).
         self._placement_strategy = placement_strategy
 
+        # Monotone per-process counter naming elastic work-queue KV
+        # namespaces: one coordination service may outlive several
+        # drains (and several train() calls) in one process lifetime.
+        self._elastic_epoch = 0
+        self._elastic_batches = None
+        self._speculation = None
+
         # One executable cache for the whole search: iteration t+1's
         # structurally-identical programs (same-architecture candidates
         # under RoundRobin, rebuilt iterations after restart) skip XLA
@@ -333,31 +439,52 @@ class Estimator:
         # gradient all-reduces over ICI/DCN. Filesystem writes stay
         # chief-only; the manifest handshake is the iteration barrier.
         if jax.process_count() > 1:
-            if self._placement_strategy is not None and not isinstance(
+            if isinstance(
+                self._placement_strategy, ElasticWorkQueueStrategy
+            ):
+                # Elastic work queue: control plane AND state transfer
+                # ride the coordination-service KV store — no SPMD mesh,
+                # no device collectives, so a dead worker costs one lease
+                # TTL, never a wedged runtime. Every process must feed
+                # the IDENTICAL (full, unsharded) batch stream: units
+                # re-issued to a survivor replay the dead worker's exact
+                # batches by absolute step index.
+                self._spmd_mesh = None
+                _LOG.info(
+                    "Multi-host elastic work queue: %d processes.",
+                    jax.process_count(),
+                )
+            elif self._placement_strategy is not None and not isinstance(
                 self._placement_strategy, RoundRobinStrategy
             ):
                 raise ValueError(
                     "Unsupported placement strategy %r for multi-process "
                     "training; use RoundRobinStrategy (cross-process "
-                    "candidate parallelism) or the default placement "
+                    "candidate parallelism), ElasticWorkQueueStrategy "
+                    "(lease-based work queue), or the default placement "
                     "(multi-host SPMD data parallelism)."
                     % (self._placement_strategy,)
                 )
-            # The full process-spanning mesh: the data plane for default
-            # SPMD training, and the replicated bookkeeping substrate for
-            # multi-host RoundRobin (training itself runs on candidate
-            # submeshes; see distributed/multihost.py).
-            self._spmd_mesh = data_parallel_mesh()
-            _LOG.info(
-                "Multi-host %s: %d processes, %d global devices.",
-                "RoundRobin"
-                if self._placement_strategy is not None
-                else "SPMD",
-                jax.process_count(),
-                len(jax.devices()),
-            )
+            else:
+                # The full process-spanning mesh: the data plane for
+                # default SPMD training, and the replicated bookkeeping
+                # substrate for multi-host RoundRobin (training itself
+                # runs on candidate submeshes; distributed/multihost.py).
+                self._spmd_mesh = data_parallel_mesh()
+                _LOG.info(
+                    "Multi-host %s: %d processes, %d global devices.",
+                    "RoundRobin"
+                    if self._placement_strategy is not None
+                    else "SPMD",
+                    jax.process_count(),
+                    len(jax.devices()),
+                )
         else:
             self._spmd_mesh = None
+        # Per-train()-call elastic scheduler state: the absolute-index
+        # batch log and the cross-iteration speculation stash.
+        self._elastic_batches = None
+        self._speculation = None
 
         # Verify-and-heal BEFORE trusting any restored bytes: corrupt
         # files are quarantined (`*.corrupt`) and the manifest rolls back
@@ -371,9 +498,19 @@ class Estimator:
             self._model_dir, repair=coordination.is_chief()
         )
         if heal.rolled_back_to_iteration is not None:
-            _LOG.warning(
-                "Checkpoint healed: rolled back to iteration %d "
+            # `verdict` is the ckpt_fsck CLI/CI contract: "healed" keeps
+            # a usable resume point; "unrecoverable" lost every trained
+            # generation — the search restarts from scratch rather than
+            # crash, but operators should know their checkpoints are gone.
+            log = (
+                _LOG.error
+                if heal.verdict == "unrecoverable"
+                else _LOG.warning
+            )
+            log(
+                "Checkpoint %s: rolled back to iteration %d "
                 "(global step %s); quarantined %s.",
+                heal.verdict,
                 heal.rolled_back_to_iteration,
                 heal.rolled_back_global_step,
                 heal.quarantined or heal.issues,
@@ -550,7 +687,18 @@ class Estimator:
                 t, sample_batch, cached_previous=cached_previous
             )
             executor = None
-            if isinstance(self._placement_strategy, RoundRobinStrategy):
+            elastic = isinstance(
+                self._placement_strategy, ElasticWorkQueueStrategy
+            )
+            if elastic:
+                from adanet_tpu.distributed.scheduler import (
+                    ElasticWorkQueueExecutor,
+                )
+
+                executor = ElasticWorkQueueExecutor(
+                    iteration, self._placement_strategy
+                )
+            elif isinstance(self._placement_strategy, RoundRobinStrategy):
                 if jax.process_count() > 1:
                     # Pod-scale candidate parallelism: groups of whole
                     # processes (or process-local device partitions) per
@@ -606,8 +754,18 @@ class Estimator:
             profiling = False
             profiled = False
             self._last_stop_check_step = steps_done
+            if elastic:
+                # Queue drain replaces the lockstep round: work units are
+                # pulled under leases, dead workers' units re-issue, and
+                # freed capacity may speculate on t+1
+                # (distributed/scheduler.py, docs/scheduler.md).
+                state, steps_done = self._drain_elastic_iteration(
+                    executor, iteration, state, info, t, steps_done,
+                    max_steps, input_fn,
+                )
             while (
-                steps_done < self._max_iteration_steps
+                not elastic
+                and steps_done < self._max_iteration_steps
                 and not self._should_stop_at(steps_done)
                 and (max_steps is None or info.global_step < max_steps)
             ):
@@ -982,6 +1140,184 @@ class Estimator:
                     "Non-finite values in input batch at %s (debug=True)."
                     % jax.tree_util.keystr(path)
                 )
+
+    # ------------------------------------------------- elastic work queue
+
+    def _drain_elastic_iteration(
+        self, executor, iteration, state, info, t, steps_done, max_steps,
+        input_fn,
+    ):
+        """One iteration as a work-queue drain (distributed/scheduler.py).
+
+        Returns the (host) state and the updated iteration-local step
+        count; `info.global_step` advances by the ensemble steps the
+        drain completed, exactly the lockstep accounting. On workers the
+        returned state is the (unmodified) entry state — bookkeeping is
+        chief-local in elastic mode, and workers sync on the manifest.
+        """
+        strategy = self._placement_strategy
+        target = self._max_iteration_steps
+        if max_steps is not None:
+            target = min(
+                target, steps_done + max(0, max_steps - info.global_step)
+            )
+        if self._elastic_batches is None:
+            self._elastic_batches = _BatchLog(
+                lambda: self._make_train_iter(input_fn),
+                check=self._check_batch_finite if self._debug else None,
+                close_iter=self._close_iter,
+            )
+        batch_log = self._elastic_batches
+        first_global = info.global_step - steps_done
+        batch_log.forget_below(first_global)
+        self._elastic_epoch += 1
+        namespace = "adanet/wq/e%d/t%d/s%d" % (
+            self._elastic_epoch, t, steps_done,
+        )
+        warm = self._take_speculation(t, iteration.previous_ensemble)
+        result = executor.run_iteration(
+            state,
+            batch_log.batch_at,
+            first_global_step=first_global,
+            target_steps=target,
+            queue_namespace=namespace,
+            should_stop=lambda: self._stop_requested,
+            warm_states=warm,
+            forget_below=batch_log.forget_below,
+        )
+        if result.state is not None:
+            state = result.state
+        steps_done += result.steps_trained
+        info.global_step += result.steps_trained
+        if (
+            self._log_every_steps
+            and result.steps_trained
+            and coordination.is_chief()
+        ):
+            emas = iteration.ema_losses(state)
+            _LOG.info(
+                "iteration %d step %d/%d (elastic drain: %d dispatched, "
+                "%d reused) adanet_loss EMAs: %s",
+                t,
+                steps_done,
+                self._max_iteration_steps,
+                result.dispatched_steps,
+                result.reused_steps,
+                {k: round(v, 6) for k, v in emas.items()},
+            )
+        if (
+            result.completed
+            and coordination.is_chief()
+            and strategy.speculate_steps > 0
+            and steps_done >= self._max_iteration_steps
+            and (
+                self._max_iterations is None
+                or t + 1 < self._max_iterations
+            )
+            and (max_steps is None or info.global_step < max_steps)
+        ):
+            self._speculate_next_iteration(
+                t, iteration, state, batch_log, info.global_step
+            )
+        return state, steps_done
+
+    def _take_speculation(self, t, previous):
+        """Warm window states for iteration `t`, or None.
+
+        The speculative winner must MATCH the actually selected previous
+        ensemble; on a flip (an Evaluator, `force_grow`, or replay chose
+        differently) the warm states are discarded — they were trained
+        against the wrong teacher.
+        """
+        spec, self._speculation = self._speculation, None
+        if spec is None or previous is None or spec["iteration"] != t:
+            return None
+        if spec["previous_name"] != previous.name:
+            _LOG.info(
+                "Discarding speculative warm start for iteration %d: "
+                "winner flipped (%s -> %s).",
+                t,
+                spec["previous_name"],
+                previous.name,
+            )
+            return None
+        return spec["states"]
+
+    def _speculate_next_iteration(
+        self, t, iteration, state, batch_log, next_global_step
+    ):
+        """Pre-trains iteration t+1's candidates against the LIKELY
+        winner (EMA argmin) on freed capacity, stashing per-window warm
+        states keyed by the speculated winner (chief-local, in-memory).
+
+        Disabled alongside a `report_materializer`: t+1's generator
+        would read reports the bookkeeping phase has not written yet.
+        """
+        from adanet_tpu.distributed.scheduler import (
+            ElasticWorkQueueExecutor,
+            InMemoryKV,
+        )
+
+        strategy = self._placement_strategy
+        spec_target = (
+            strategy.speculate_steps
+            // strategy.window_steps
+            * strategy.window_steps
+        )
+        spec_target = min(spec_target, self._max_iteration_steps)
+        if spec_target <= 0 or self._report_materializer is not None:
+            return
+        try:
+            likely = iteration.best_candidate_index(state)
+        except FloatingPointError:
+            return  # every candidate dead: nothing to speculate against
+        likely_name = iteration.candidate_names()[likely]
+        sample = batch_log.batch_at(next_global_step)
+        try:
+            frozen_guess = iteration.freeze_candidate(
+                state, likely_name, sample
+            )
+            builders = self._generate_builders(t + 1, frozen_guess)
+            next_iteration = self._iteration_builder.build_iteration(
+                t + 1, builders, frozen_guess
+            )
+            spec_state = next_iteration.init_state(
+                self._iteration_rng(t + 1), sample
+            )
+            spec_executor = ElasticWorkQueueExecutor(
+                next_iteration, strategy, kv=InMemoryKV()
+            )
+            result = spec_executor.run_iteration(
+                spec_state,
+                batch_log.batch_at,
+                first_global_step=next_global_step,
+                target_steps=spec_target,
+                queue_namespace="adanet/wq/spec/t%d" % (t + 1),
+                subnetworks_only=True,
+            )
+        except Exception as exc:
+            # Speculation is an optimization; it must never take the
+            # real search down with it.
+            _LOG.warning(
+                "Speculative training for iteration %d failed "
+                "(continuing without warm start): %s",
+                t + 1,
+                exc,
+            )
+            return
+        self._speculation = {
+            "iteration": t + 1,
+            "previous_name": frozen_guess.name,
+            "states": result.window_states,
+        }
+        _LOG.info(
+            "Speculatively trained %d steps of iteration %d's %d "
+            "candidates against likely winner %r.",
+            spec_target,
+            t + 1,
+            len(builders),
+            likely_name,
+        )
 
     def _write_train_summaries(
         self, iteration, metrics, emas, global_step, state=None

@@ -26,14 +26,28 @@ from adanet_tpu.distributed.mesh import (
     shard_batch,
 )
 from adanet_tpu.distributed.placement import (
+    ElasticWorkQueueStrategy,
     PlacementStrategy,
     ReplicationStrategy,
     RoundRobinStrategy,
 )
+from adanet_tpu.distributed.scheduler import (
+    ElasticWorkQueueExecutor,
+    InMemoryKV,
+    WorkQueue,
+    WorkQueueConfig,
+    WorkUnit,
+)
 
 __all__ = [
+    "ElasticWorkQueueExecutor",
+    "ElasticWorkQueueStrategy",
+    "InMemoryKV",
     "MultiHostRoundRobinExecutor",
     "PlacementStrategy",
+    "WorkQueue",
+    "WorkQueueConfig",
+    "WorkUnit",
     "multihost_candidate_groups",
     "ReplicationStrategy",
     "RoundRobinExecutor",

@@ -22,7 +22,8 @@ per *submesh* which jit-compiled steps run where:
 from __future__ import annotations
 
 import abc
-from typing import List, Optional, Sequence
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 from jax.sharding import Mesh
@@ -127,3 +128,74 @@ class RoundRobinStrategy(PlacementStrategy):
 
     def ensemble_mesh(self, num_subnetworks):
         return mesh_lib.data_parallel_mesh(self._groups(num_subnetworks)[0])
+
+
+@dataclasses.dataclass
+class ElasticWorkQueueStrategy(PlacementStrategy):
+    """Pull-based elastic placement: submeshes claim work units under
+    TTL leases instead of owning a candidate for the whole round
+    (`distributed/scheduler.py`; ROADMAP item 3).
+
+    Every group's programs compile for one uniform local *unit submesh*,
+    so any worker can run any unit and a unit's numerics depend only on
+    the submesh size — pin `unit_devices` across elastic topologies for
+    bit-identical shrunk/grown-back trajectories.
+
+    Args:
+      window_steps: training steps per work unit (the re-issue and
+        member-staleness granule; the `iterations_per_loop` analogue).
+      lease_ttl_secs: lease TTL; a worker silent for this long is
+        presumed dead and its unit re-issues (`ADANET_LEASE_TTL_SECS`).
+      max_attempts: re-issues per unit before the candidate is poisoned
+        into the `CandidateState.dead` quarantine path.
+      unit_devices: local devices per unit submesh (None = all local).
+      speculate_steps: when > 0, freed capacity pre-trains this many
+        steps of iteration t+1's candidates against the likely winner;
+        the warm states are discarded if the selected winner flips.
+      kv / clock: injectable store and clock for deterministic tests.
+    """
+
+    window_steps: int = 4
+    lease_ttl_secs: Optional[float] = None
+    max_attempts: int = 3
+    unit_devices: Optional[int] = None
+    speculate_steps: int = 0
+    poll_interval_secs: float = 0.05
+    drain_timeout_secs: Optional[float] = None
+    kv: Optional[Any] = None
+    clock: Optional[Callable[[], float]] = None
+
+    def queue_config(self):
+        from adanet_tpu.distributed.scheduler import WorkQueueConfig
+
+        config = WorkQueueConfig(
+            window_steps=self.window_steps,
+            max_attempts=self.max_attempts,
+            poll_interval_secs=self.poll_interval_secs,
+        )
+        if self.lease_ttl_secs is not None:
+            config.lease_ttl_secs = float(self.lease_ttl_secs)
+        if self.drain_timeout_secs is not None:
+            config.drain_timeout_secs = float(self.drain_timeout_secs)
+        return config
+
+    def _unit_mesh(self) -> Mesh:
+        devices = jax.local_devices()
+        if self.unit_devices is not None:
+            devices = devices[: max(1, min(self.unit_devices, len(devices)))]
+        return mesh_lib.data_parallel_mesh(devices)
+
+    def should_build_ensemble(self, num_subnetworks):
+        return True
+
+    def should_build_subnetwork(self, num_subnetworks, subnetwork_index):
+        return True
+
+    def should_train_subnetworks(self, num_subnetworks):
+        return True
+
+    def subnetwork_mesh(self, num_subnetworks, subnetwork_index):
+        return self._unit_mesh()
+
+    def ensemble_mesh(self, num_subnetworks):
+        return self._unit_mesh()

@@ -1,0 +1,1413 @@
+"""Elastic work-queue candidate scheduler with lease-based fault recovery.
+
+The lockstep executors (`executor.py`, `multihost.py`) train every
+candidate for the same budget: the slowest submesh gates the round, and
+a dead or early-stopped candidate strands its devices. This module
+decomposes an iteration into **work units** — (candidate × step-window)
+and (ensemble × step-window) — published on a coordination-service KV
+store. Submeshes PULL units under a TTL lease renewed by heartbeat:
+
+- a SIGKILLed, preempted, or hung worker's lease expires and its unit is
+  re-issued to a survivor (bounded by `max_attempts`, then the candidate
+  is poisoned into the existing `CandidateState.dead` quarantine path) —
+  no round ever blocks on a dead peer;
+- early-stopped (per-candidate step budget) and poisoned candidates
+  simply stop producing units, releasing capacity immediately;
+- freed capacity can *speculatively* pre-train iteration t+1 candidates
+  against the likely winner (driven by `core/estimator.py`; the warm
+  states are discarded when the selected winner flips).
+
+Work units are DETERMINISTIC pure functions: a unit's output depends
+only on (input state, its batch indices, its derived RNG keys), never on
+wall-clock scheduling. Duplicate execution — a slow-but-alive worker
+racing the re-issued copy — is therefore harmless: the first completion
+wins the `done/` marker and both results are bit-identical. The same
+property makes the elastic search reproducible across topologies: a
+2-process pool, a shrunk 1-process pool, and a grown-back pool all train
+the exact same trajectory (proven by the oracle-parity tests in
+`tests/test_distributed.py`).
+
+Control plane and state transfer ride the coordination-service KV store
+exclusively — there are NO device collectives, so the scheduler is
+immune to the dead-peer-wedges-the-local-runtime failure mode
+(`multihost._broadcast_tree`'s design note) and to the pre-0.5 gloo
+unframed-pair abort (`tests/test_distributed.py::_GLOO_UNFRAMED_PAIR`).
+Every KV wait is bounded (jaxlint JL009). See docs/scheduler.md for the
+work-unit lifecycle and the lease/heartbeat state machine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from adanet_tpu.distributed import mesh as mesh_lib
+from adanet_tpu.distributed.executor import (
+    CANDIDATE_FAULTS,
+    RoundRobinExecutor,
+)
+from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.watchdog import (
+    PeerLostError,
+    collective_timeout_secs,
+)
+
+_LOG = logging.getLogger("adanet_tpu")
+
+#: gRPC caps messages at 4 MiB; state payloads are chunked below it
+#: (same bound as multihost._KV_CHUNK_BYTES).
+_KV_CHUNK_BYTES = 2 << 20
+
+ENSEMBLE = "__ensemble__"
+
+#: Lease TTL for same-process drains (`drain_callables`), where worker
+#: "death" is impossible and lease expiry would only add failure modes.
+_IN_PROCESS_LEASE_TTL = 24 * 3600.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        _LOG.warning("Ignoring non-numeric %s=%r.", name, raw)
+        return default
+
+
+class LeaseLostError(RuntimeError):
+    """This worker's lease was re-issued to another worker."""
+
+
+# --------------------------------------------------------------- KV stores
+
+
+class InMemoryKV:
+    """Thread-safe in-process KV store with the coordination surface.
+
+    Serves single-process elastic runs and the `ParallelScheduler` shim
+    (`experimental/phases.py`), and doubles as the deterministic test
+    double for the coordination-service client. Values are arbitrary
+    Python objects (no serialization round-trip in-process).
+    """
+
+    def __init__(self):
+        self._store: Dict[str, Any] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value, overwrite: bool = True) -> bool:
+        """Stores `value`; returns False when `key` exists and
+        `overwrite` is False (the set-once claim primitive)."""
+        with self._cond:
+            if not overwrite and key in self._store:
+                return False
+            self._store[key] = value
+            self._cond.notify_all()
+            return True
+
+    def get(self, key: str, timeout_secs: float):
+        """Blocking get bounded by `timeout_secs` (raises TimeoutError)."""
+        deadline = time.monotonic() + timeout_secs
+        with self._cond:
+            while key not in self._store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    if key in self._store:
+                        break
+                    raise TimeoutError(
+                        "key %r not set within %.1fs" % (key, timeout_secs)
+                    )
+            return self._store[key]
+
+    def try_get(self, key: str):
+        with self._cond:
+            return self._store.get(key)
+
+    def scan(self, prefix: str) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                k: v for k, v in self._store.items() if k.startswith(prefix)
+            }
+
+    def delete(self, key: str) -> None:
+        with self._cond:
+            self._store.pop(key, None)
+
+
+class CoordinationKV:
+    """The jax coordination-service client behind the same surface.
+
+    `set(overwrite=False)` maps onto the service's atomic
+    insert-if-absent, which is what makes lease claims race-free across
+    processes. Every get is bounded (jaxlint JL009): a dead coordinator
+    costs one timeout, never a hang.
+
+    Values ride the STRING key-value API base64-encoded: on jaxlib
+    0.4.x, `blocking_key_value_get_bytes` on the coordinator-hosting
+    process SEGFAULTS when the value was set by a remote task (a
+    dangling view on the local-service fast path; reproduced in
+    isolation — the string variant copies and is safe). The ~33% value
+    overhead is the price of running on this jaxlib; drop the encoding
+    once the fleet is on a jaxlib with the bytes path fixed.
+    """
+
+    def __init__(self, client):
+        self._client = client
+
+    @staticmethod
+    def _encode(value) -> str:
+        import base64
+
+        if isinstance(value, str):
+            value = value.encode()
+        return base64.b64encode(value).decode("ascii")
+
+    @staticmethod
+    def _decode(value) -> bytes:
+        import base64
+
+        return base64.b64decode(value)
+
+    def set(self, key: str, value, overwrite: bool = True) -> bool:
+        try:
+            self._client.key_value_set(
+                key, self._encode(value), allow_overwrite=overwrite
+            )
+            return True
+        except Exception as exc:
+            # Only the service's insert-if-absent rejection means "lost
+            # the set-once race" ("ALREADY_EXISTS: Config key ... already
+            # exists." on this jaxlib). A transport/coordinator failure
+            # must surface: swallowing it as a lost race would let a
+            # failed chief publish() look like "someone else published"
+            # while workers block on a key that was never written.
+            if not overwrite and "ALREADY_EXISTS" in str(exc):
+                return False
+            raise
+
+    def get(self, key: str, timeout_secs: float) -> bytes:
+        timeout_ms = max(1, int(timeout_secs * 1000))
+        return self._decode(
+            self._client.blocking_key_value_get(key, timeout_ms)
+        )
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            # 50ms bound: an absent key answers with DeadlineExceeded —
+            # cheap on the local-coordinator deployments this serves,
+            # and a wedged channel still cannot park the caller.
+            return self._decode(
+                self._client.blocking_key_value_get(key, 50)
+            )
+        except Exception:
+            return None
+
+    def scan(self, prefix: str) -> Dict[str, Any]:
+        try:
+            return {
+                key: self._decode(value)
+                for key, value in self._client.key_value_dir_get(prefix)
+            }
+        except Exception:
+            return {}
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(key)
+        except Exception:
+            pass
+
+
+def coordination_kv():
+    """The live coordination-service KV, or None single-process."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    return CoordinationKV(client) if client is not None else None
+
+
+# ---------------------------------------------------------- tree blob codec
+
+
+def encode_tree(tree) -> bytes:
+    """Host pytree -> one byte blob (leaves in tree order, raw dtypes).
+
+    The receiving side rebuilds against a same-structure template
+    (`decode_tree`), exactly the fused-blob protocol of
+    `multihost._broadcast_tree` — one KV round per state, chunked under
+    the gRPC cap by the caller.
+    """
+    leaves = jax.tree_util.tree_leaves(jax.device_get(tree))
+    return b"".join(np.asarray(leaf).tobytes() for leaf in leaves)
+
+
+def decode_tree(template, blob: bytes):
+    """Rebuilds a pytree from `encode_tree` bytes using `template`'s
+    structure, dtypes, and shapes."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    rebuilt = []
+    offset = 0
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        chunk = blob[offset : offset + arr.nbytes]
+        rebuilt.append(
+            np.frombuffer(chunk, dtype=arr.dtype).reshape(arr.shape)
+        )
+        offset += arr.nbytes
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
+# ---------------------------------------------------------------- work units
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkUnit:
+    """One schedulable unit: `num_steps` training steps of one candidate
+    (or of the ensemble group) starting at iteration-local `start_step`."""
+
+    kind: str  # "subnetwork" | "ensemble"
+    name: str  # candidate name, or ENSEMBLE
+    start_step: int
+    num_steps: int
+
+    @property
+    def uid(self) -> str:
+        return "%s/s%d+%d" % (self.name, self.start_step, self.num_steps)
+
+    @property
+    def end_step(self) -> int:
+        return self.start_step + self.num_steps
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(obj: dict) -> "WorkUnit":
+        return WorkUnit(**obj)
+
+
+def plan_windows(
+    start: int, stop: int, window_steps: int
+) -> List[Tuple[int, int]]:
+    """K-grid-aligned (start, num_steps) windows covering [start, stop).
+
+    Windows break at multiples of `window_steps` regardless of `start`,
+    so a run resumed from any checkpointed step re-joins the same global
+    window grid (unit ids — and therefore re-issue bookkeeping and
+    speculative warm-starts — stay stable across restarts).
+    """
+    if window_steps < 1:
+        raise ValueError("window_steps must be >= 1.")
+    windows = []
+    s = start
+    while s < stop:
+        e = min(stop, (s // window_steps + 1) * window_steps)
+        windows.append((s, e - s))
+        s = e
+    return windows
+
+
+@dataclasses.dataclass
+class WorkQueueConfig:
+    """Queue tuning knobs (env-overridable where operators need them)."""
+
+    window_steps: int = 4
+    lease_ttl_secs: float = dataclasses.field(
+        default_factory=lambda: _env_float("ADANET_LEASE_TTL_SECS", 15.0)
+    )
+    max_attempts: int = 3
+    poll_interval_secs: float = 0.05
+    #: No claimable unit AND no completion for this long => the queue is
+    #: wedged (e.g. the chief holding the ensemble tail died): raise
+    #: PeerLostError instead of polling forever.
+    drain_timeout_secs: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "ADANET_DRAIN_TIMEOUT_SECS", 600.0
+        )
+    )
+
+    @property
+    def renew_interval_secs(self) -> float:
+        return max(0.05, self.lease_ttl_secs / 3.0)
+
+
+class WorkQueue:
+    """Lease-based work queue over a KV store.
+
+    Key layout under `namespace`:
+      units                 JSON list of every unit (published once)
+      claim/<uid>/<n>       set-once claim token for attempt n
+      lease/<uid>           {owner, attempt, deadline} (renewed)
+      done/<uid>            {owner, attempt} (set-once, terminal)
+      state/...             completion payloads (written before done/)
+      poison/<name>         candidate quarantined (attempts exhausted)
+      final/<name>          last completed end_step of a poisoned candidate
+
+    Lifecycle of a unit: pending -> claimed(n) -> done, or
+    claimed(n) -> lease expired -> claimed(n+1) -> ... -> poison after
+    `max_attempts`. `done/` is set-once so duplicate executions (an
+    expired-but-alive worker racing the re-issue) resolve to exactly one
+    authoritative result.
+    """
+
+    def __init__(
+        self,
+        kv,
+        namespace: str,
+        config: WorkQueueConfig,
+        worker: str,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._kv = kv
+        self._ns = namespace.rstrip("/")
+        self.config = config
+        self.worker = worker
+        self._clock = clock
+        self._units: List[WorkUnit] = []
+        self._done_cache: Dict[str, dict] = {}
+        self._poison_cache: Dict[str, str] = {}
+
+    # ------------------------------------------------------------- keys
+
+    def _key(self, *parts) -> str:
+        return "/".join([self._ns] + [str(p) for p in parts])
+
+    @property
+    def namespace(self) -> str:
+        return self._ns
+
+    # ------------------------------------------------------ publish/load
+
+    def publish(self, units: List[WorkUnit]) -> None:
+        """Publishes the full unit list (chief-only, once per drain)."""
+        payload = json.dumps([u.to_json() for u in units])
+        self._kv.set(self._key("units"), payload, overwrite=False)
+        self._units = list(units)
+
+    def load(self, timeout_secs: float) -> List[WorkUnit]:
+        """Blocks until the chief publishes, then caches the unit list."""
+        raw = self._kv.get(self._key("units"), timeout_secs)
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        self._units = [WorkUnit.from_json(o) for o in json.loads(raw)]
+        return list(self._units)
+
+    def attach(self, units: List[WorkUnit]) -> None:
+        """Adopts an already-loaded unit list (same namespace)."""
+        self._units = list(units)
+
+    @property
+    def units(self) -> List[WorkUnit]:
+        return list(self._units)
+
+    # ------------------------------------------------------------ status
+
+    @staticmethod
+    def _json_value(value):
+        if value is None:
+            return None
+        if isinstance(value, bytes):
+            value = value.decode()
+        if isinstance(value, str):
+            return json.loads(value)
+        return value
+
+    def refresh(self) -> None:
+        """One scan per status prefix instead of a bounded-blocking get
+        per key: done/poison markers are monotone, so the caches only
+        ever grow and staleness is benign (a unit looks pending a beat
+        longer, never done when it is not)."""
+        done_prefix = self._key("done")
+        for key, value in self._kv.scan(done_prefix).items():
+            uid = key[len(done_prefix) + 1 :]
+            if uid and uid not in self._done_cache:
+                self._done_cache[uid] = self._json_value(value)
+        poison_prefix = self._key("poison")
+        for key, value in self._kv.scan(poison_prefix).items():
+            name = key[len(poison_prefix) + 1 :]
+            if isinstance(value, bytes):
+                value = value.decode()
+            if name:
+                self._poison_cache[name] = value
+
+    def is_done(self, unit: WorkUnit) -> bool:
+        return unit.uid in self._done_cache
+
+    def poisoned(self, name: str) -> Optional[str]:
+        return self._poison_cache.get(name)
+
+    def poison(self, name: str, reason: str, final_step: int) -> None:
+        """Quarantines a candidate: its remaining units stop re-issuing
+        and readers fall back to its last completed state."""
+        self._poison_cache[name] = reason
+        if self._kv.set(self._key("poison", name), reason, overwrite=False):
+            self._kv.set(self._key("final", name), str(int(final_step)))
+            _LOG.error(
+                "Work-queue candidate %r poisoned after %d attempts: %s",
+                name,
+                self.config.max_attempts,
+                reason,
+            )
+
+    def final_step(self, name: str, fallback: int) -> int:
+        value = self._kv.try_get(self._key("final", name))
+        if value is None:
+            return fallback
+        if isinstance(value, bytes):
+            value = value.decode()
+        return int(value)
+
+    def last_completed_step(self, name: str, entry_step: int) -> int:
+        """Largest end_step among this candidate's done units."""
+        best = entry_step
+        for unit in self._units:
+            if unit.name == name and self.is_done(unit):
+                best = max(best, unit.end_step)
+        return best
+
+    def settled(self, unit: WorkUnit) -> bool:
+        """Done, or never coming (its candidate is poisoned)."""
+        return self.is_done(unit) or (
+            unit.kind == "subnetwork" and self.poisoned(unit.name) is not None
+        )
+
+    def drained(self) -> bool:
+        self.refresh()
+        return all(self.settled(u) for u in self._units)
+
+    # ------------------------------------------------------------- claims
+
+    def _lease(self, unit: WorkUnit) -> Optional[dict]:
+        return self._json_value(self._kv.try_get(self._key("lease", unit.uid)))
+
+    def claim(
+        self, ready: Callable[[WorkUnit], bool], can_run: Callable[[WorkUnit], bool]
+    ) -> Optional[Tuple[WorkUnit, int]]:
+        """Claims the first pending-or-expired ready unit, in published
+        order (deterministic). Returns (unit, attempt) or None."""
+        self.refresh()
+        now = self._clock()
+        for unit in self._units:
+            if self.settled(unit) or not can_run(unit):
+                continue
+            if not ready(unit):
+                continue
+            lease = self._lease(unit)
+            if lease is None:
+                attempt = 0
+            elif float(lease["deadline"]) > now:
+                continue  # live lease: someone is (believed) working on it
+            else:
+                attempt = int(lease["attempt"]) + 1
+            won = self._claim_attempt(unit, attempt)
+            if won is not None:
+                return unit, won
+        return None
+
+    def _claim_token(self) -> str:
+        return json.dumps(
+            {
+                "owner": self.worker,
+                "deadline": self._clock() + self.config.lease_ttl_secs,
+            }
+        )
+
+    def _claim_token_value(self, key: str) -> Optional[dict]:
+        try:
+            value = self._json_value(self._kv.try_get(key))
+        except ValueError:
+            return None
+        return value if isinstance(value, dict) else None
+
+    def _claim_attempt(self, unit: WorkUnit, attempt: int) -> Optional[int]:
+        """Wins the set-once claim token for `attempt` — or a successor.
+
+        The token carries its own deadline so the claim->lease window is
+        crash-recoverable: a worker SIGKILLed after winning the token
+        but before writing its lease would otherwise park the unit
+        forever (every later claimant recomputes the same attempt from
+        the absent lease and loses the same set-once race). Losing the
+        race against a live token (or a lease at this attempt) means the
+        unit is being worked on; losing against an EXPIRED token with no
+        matching lease means the winner died mid-claim and the next
+        attempt is free to take.
+        """
+        while True:
+            if attempt >= self.config.max_attempts:
+                if unit.kind != "ensemble":
+                    self.poison(
+                        unit.name,
+                        "unit %s exhausted %d lease attempts (workers "
+                        "died or hung mid-unit)" % (unit.uid, attempt),
+                        final_step=self.last_completed_step(
+                            unit.name, unit.start_step
+                        ),
+                    )
+                    return None
+                # The ensemble cannot be quarantined away (it IS the
+                # selection state), and only the chief may run it: keep
+                # re-claiming without bound. A stalled-but-alive chief
+                # recovers, duplicate executions are arbitrated by the
+                # set-once done/ marker, and a DEAD chief is the
+                # workers' drain-timeout PeerLostError — not a poison.
+            token_key = self._key("claim", unit.uid, attempt)
+            if self._kv.set(token_key, self._claim_token(), overwrite=False):
+                self._write_lease(unit, attempt)
+                return attempt
+            lease = self._lease(unit)
+            if lease is not None and int(lease["attempt"]) >= attempt:
+                return None  # the token winner wrote its lease: live
+            token = self._claim_token_value(token_key)
+            if token is None or float(token.get("deadline", 0.0)) > self._clock():
+                return None  # winner presumed alive (mid claim->lease)
+            attempt += 1
+
+    def _write_lease(self, unit: WorkUnit, attempt: int, expired=False):
+        deadline = 0.0 if expired else self._clock() + self.config.lease_ttl_secs
+        self._kv.set(
+            self._key("lease", unit.uid),
+            json.dumps(
+                {
+                    "owner": self.worker,
+                    "attempt": attempt,
+                    "deadline": deadline,
+                }
+            ),
+        )
+
+    def renew(self, unit: WorkUnit, attempt: int) -> None:
+        """Heartbeat: extends this worker's lease on `unit`.
+
+        Raises `LeaseLostError` when the lease was re-issued to another
+        worker (this worker was declared dead — its eventual result is
+        discarded by the set-once `done/` marker anyway).
+        """
+        faults.trip("lease.renew")
+        lease = self._lease(unit)
+        if (
+            lease is None
+            or int(lease["attempt"]) != attempt
+            or lease["owner"] != self.worker
+        ):
+            raise LeaseLostError(
+                "lease on %s (attempt %d) re-issued to %s"
+                % (unit.uid, attempt, lease and lease.get("owner"))
+            )
+        self._write_lease(unit, attempt)
+
+    def release(self, unit: WorkUnit, attempt: int) -> None:
+        """Expires this worker's own lease so the unit re-issues
+        immediately (used after a unit-scoped fault)."""
+        lease = self._lease(unit)
+        if lease and int(lease["attempt"]) == attempt:
+            self._write_lease(unit, attempt, expired=True)
+
+    # ------------------------------------------------------- completions
+
+    def complete(self, unit: WorkUnit, attempt: int, blob: Optional[bytes]) -> bool:
+        """Publishes a unit result; returns False when another execution
+        already won (duplicate results are bit-identical by the
+        determinism contract, so losing is harmless)."""
+        if blob is not None:
+            prefix = self._key("state", unit.uid, attempt)
+            nchunks = max(1, -(-len(blob) // _KV_CHUNK_BYTES))
+            for i in range(nchunks):
+                self._kv.set(
+                    "%s/%d" % (prefix, i),
+                    blob[i * _KV_CHUNK_BYTES : (i + 1) * _KV_CHUNK_BYTES],
+                )
+            self._kv.set("%s/n" % prefix, str(nchunks))
+        won = self._kv.set(
+            self._key("done", unit.uid),
+            json.dumps({"owner": self.worker, "attempt": attempt}),
+            overwrite=False,
+        )
+        return won
+
+    def read_blob(self, unit: WorkUnit, timeout_secs: float) -> bytes:
+        """The authoritative completion payload of a done unit."""
+        record = self._json_value(
+            self._kv.get(self._key("done", unit.uid), timeout_secs)
+        )
+        prefix = self._key("state", unit.uid, record["attempt"])
+        raw_n = self._kv.get("%s/n" % prefix, timeout_secs)
+        if isinstance(raw_n, bytes):
+            raw_n = raw_n.decode()
+        chunks = [
+            self._kv.get("%s/%d" % (prefix, i), timeout_secs)
+            for i in range(int(raw_n))
+        ]
+        return b"".join(chunks)
+
+
+class LeaseRenewer:
+    """Background heartbeat renewing one unit's lease during execution.
+
+    The work-unit analogue of `watchdog.HeartbeatWriter`: training a
+    window blocks the worker thread in device dispatch, so renewal runs
+    on a daemon thread. A lost lease is recorded, not raised — the unit
+    finishes and the set-once completion marker arbitrates.
+    """
+
+    def __init__(self, queue: WorkQueue, unit: WorkUnit, attempt: int):
+        self._queue = queue
+        self._unit = unit
+        self._attempt = attempt
+        self._stop = threading.Event()
+        self.lost: Optional[LeaseLostError] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "LeaseRenewer":
+        def run():
+            interval = self._queue.config.renew_interval_secs
+            while not self._stop.wait(interval):
+                try:
+                    self._queue.renew(self._unit, self._attempt)
+                except LeaseLostError as exc:
+                    self.lost = exc
+                    return
+                except Exception as exc:  # renewal is best-effort
+                    _LOG.warning(
+                        "Lease renewal for %s failed: %s",
+                        self._unit.uid,
+                        exc,
+                    )
+
+        self._thread = threading.Thread(
+            target=run, name="lease-%s" % self._unit.uid, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self._queue.config.renew_interval_secs + 1.0)
+
+
+# ------------------------------------------------------- elastic executor
+
+
+@dataclasses.dataclass
+class ElasticRunResult:
+    """Outcome of one queue drain (chief fields None on workers)."""
+
+    state: Optional[Any]  # host IterationState (chief) / None (worker)
+    steps_trained: int  # ensemble steps completed by THIS call
+    completed: bool  # reached the planned target (False: stop request)
+    dispatched_steps: int  # candidate+ensemble steps this process ran
+    reused_steps: int  # speculative warm-start steps grafted in
+    metrics: Dict[str, Any]
+    #: Completed subnetwork window states this process holds, keyed
+    #: {candidate: {end_step: state}} — the speculation hand-off.
+    window_states: Dict[str, Dict[int, Any]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class ElasticWorkQueueExecutor(RoundRobinExecutor):
+    """Drives one iteration by draining the lease-based work queue.
+
+    Reuses the RoundRobin executor's per-candidate jitted programs (the
+    same `lax.scan` windows, so a unit's training trajectory is exactly
+    `iterations_per_loop`-style windowed training); only the DRIVE
+    differs — pull-based units instead of lockstep rounds. Each unit
+    trains on this process's local unit submesh; state moves between
+    processes as KV blobs, never device collectives.
+    """
+
+    is_multihost = False
+
+    def __init__(self, iteration, strategy, kv=None):
+        from adanet_tpu.distributed.placement import (
+            ElasticWorkQueueStrategy,
+        )
+
+        if not isinstance(strategy, ElasticWorkQueueStrategy):
+            raise TypeError(
+                "ElasticWorkQueueExecutor needs an ElasticWorkQueueStrategy,"
+                " got %r" % (strategy,)
+            )
+        for spec in iteration.subnetwork_specs:
+            if getattr(spec.builder, "train_input_fn", None) is not None:
+                raise ValueError(
+                    "Per-candidate input pipelines (bagging) are not "
+                    "supported by the elastic work-queue scheduler yet; "
+                    "use RoundRobinStrategy for builder %r." % spec.name
+                )
+        self.elastic_strategy = strategy
+        self._clock = strategy.clock or time.time
+        self._injected_kv = kv if kv is not None else strategy.kv
+        try:
+            self._process_index = jax.process_index()
+            self._process_count = jax.process_count()
+        except RuntimeError:  # backend not initialized (pure unit tests)
+            self._process_index, self._process_count = 0, 1
+        super().__init__(iteration, None, sync_every=1)
+        self._host_template = None
+        self._batch_timeout = collective_timeout_secs() or 600.0
+
+    # -------------------------------------------------------------- topology
+
+    def _build_meshes(self) -> None:
+        devices = jax.local_devices()
+        n = self.elastic_strategy.unit_devices
+        if n is not None:
+            devices = devices[: max(1, min(n, len(devices)))]
+        self._unit_mesh = mesh_lib.data_parallel_mesh(devices)
+        # Every group's programs compile for the (uniform) unit submesh:
+        # any worker can run any unit, and a unit's numerics depend only
+        # on the submesh SIZE — pin `unit_devices` across topologies for
+        # bit-identical elastic/shrunk/grown-back trajectories.
+        self._sub_meshes = {
+            spec.name: self._unit_mesh
+            for spec in self.iteration.subnetwork_specs
+        }
+        self._ens_mesh = self._unit_mesh
+
+    @property
+    def is_chief(self) -> bool:
+        return self._process_index == 0
+
+    # --------------------------------------------------------------- state
+
+    def place(self, state):
+        """Elastic state lives host-side; units replicate on claim."""
+        state = jax.device_get(state)
+        self._host_template = state
+        return state
+
+    def gather(self, state):
+        return jax.device_get(state)
+
+    # ------------------------------------------------------------ planning
+
+    def _candidate_caps(self, target_steps: int) -> Dict[str, int]:
+        """Per-candidate training horizon: the iteration target capped by
+        the builder's own budget (`train_steps_budget`) — the early-stop
+        contract that frees capacity under heterogeneous budgets."""
+        caps = {}
+        for spec in self.iteration.subnetwork_specs:
+            budget = getattr(spec.builder, "train_steps_budget", None)
+            caps[spec.name] = (
+                int(min(target_steps, budget))
+                if budget is not None
+                else int(target_steps)
+            )
+        return caps
+
+    def plan_units(
+        self, state, target_steps: int, subnetworks_only: bool = False
+    ) -> List[WorkUnit]:
+        """The deterministic unit list for this drain, in claim order:
+        window by window, candidates before the window's ensemble unit —
+        the pull-based analogue of the lockstep dispatch cadence."""
+        k = self.elastic_strategy.window_steps
+        caps = self._candidate_caps(target_steps)
+        starts = {
+            name: int(jax.device_get(st.step))
+            for name, st in state.subnetworks.items()
+        }
+        ens_start = int(jax.device_get(state.iteration_step))
+        per_name = {
+            name: plan_windows(starts[name], caps[name], k)
+            for name in starts
+        }
+        ens_windows = (
+            [] if subnetworks_only
+            else plan_windows(ens_start, int(target_steps), k)
+        )
+        boundaries = sorted(
+            {s + n for ws in per_name.values() for s, n in ws}
+            | {s + n for s, n in ens_windows}
+        )
+        units: List[WorkUnit] = []
+        for boundary in boundaries:
+            for spec in self.iteration.subnetwork_specs:
+                for s, n in per_name[spec.name]:
+                    if s + n == boundary:
+                        units.append(
+                            WorkUnit("subnetwork", spec.name, s, n)
+                        )
+            for s, n in ens_windows:
+                if s + n == boundary:
+                    units.append(WorkUnit("ensemble", ENSEMBLE, s, n))
+        return units
+
+    # ----------------------------------------------------------- execution
+
+    def _unit_rngs(self, base_rng, spec_index: int, start: int, num: int):
+        """Per-step keys derived from (iteration rng, candidate, absolute
+        step) — independent of scheduling order and re-issue count, so a
+        re-executed unit replays the identical stochastic trajectory."""
+        import jax.numpy as jnp
+
+        keys = [
+            jax.random.fold_in(
+                jax.random.fold_in(base_rng, spec_index), step
+            )
+            for step in range(start, start + num)
+        ]
+        return jnp.stack(keys)
+
+    def _stacked_batch(self, batch_at, first_global_step: int, unit: WorkUnit):
+        batches = [
+            batch_at(first_global_step + s)
+            for s in range(unit.start_step, unit.end_step)
+        ]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
+        )
+        return mesh_lib.shard_batch(stacked, self._unit_mesh, stacked=True)
+
+    def _context_args(self, name: str):
+        if not self._needs_context[name]:
+            return ()
+        if name not in self._sub_frozen:
+            self._sub_frozen[name] = mesh_lib.replicate_state(
+                self._host_template.frozen, self._unit_mesh
+            )
+            prev_name = self.iteration.ensemble_specs[0].name
+            self._sub_prev_params[name] = mesh_lib.replicate_state(
+                self._host_template.ensembles[prev_name].params,
+                self._unit_mesh,
+            )
+        return (self._sub_frozen[name], self._sub_prev_params[name])
+
+    def _run_subnetwork_unit(
+        self, unit: WorkUnit, state_in, base_rng, batch_at, first_global_step
+    ):
+        """Executes one candidate window; returns the host output state."""
+        faults.trip("workunit.execute")
+        spec_index = [
+            i
+            for i, s in enumerate(self.iteration.subnetwork_specs)
+            if s.name == unit.name
+        ][0]
+        st = mesh_lib.replicate_state(state_in, self._unit_mesh)
+        sub_batch = self._stacked_batch(batch_at, first_global_step, unit)
+        keys = self._unit_rngs(
+            base_rng, spec_index, unit.start_step, unit.num_steps
+        )
+        context = self._context_args(unit.name)
+        if context:
+            new_st, loss, extra = self._sub_multi_steps[unit.name](
+                st, context[0], context[1], sub_batch, keys
+            )
+        else:
+            new_st, loss, extra = self._sub_multi_steps[unit.name](
+                st, sub_batch, keys
+            )
+        return (
+            jax.device_get(new_st),
+            {"subnetwork_loss/%s" % unit.name: jax.device_get(loss)},
+        )
+
+    def _run_ensemble_unit(
+        self, unit: WorkUnit, ens_cands_in, member_vars, frozen_dev,
+        batch_at, first_global_step,
+    ):
+        """One ensemble window: every candidate's mixture-weight/EMA
+        update against fixed member params (the PS-staleness analogue:
+        members are end-of-window states, staleness <= window_steps)."""
+        faults.trip("workunit.execute")
+        ens, cands = ens_cands_in
+        ens = mesh_lib.replicate_state(ens, self._unit_mesh)
+        cands = mesh_lib.replicate_state(cands, self._unit_mesh)
+        members_dev = {
+            name: mesh_lib.replicate_state(vars_, self._unit_mesh)
+            for name, vars_ in member_vars.items()
+        }
+        ens_batch = self._stacked_batch(batch_at, first_global_step, unit)
+        new_ens, new_cands, metrics = self._ens_multi_step(
+            ens, cands, frozen_dev, members_dev, ens_batch
+        )
+        return (
+            jax.device_get((new_ens, new_cands)),
+            jax.device_get(metrics),
+        )
+
+    # ------------------------------------------------------------ the drain
+
+    def run_iteration(
+        self,
+        state,
+        batch_at: Callable[[int], Any],
+        first_global_step: int,
+        target_steps: int,
+        queue_namespace: str,
+        should_stop: Optional[Callable[[], bool]] = None,
+        warm_states: Optional[Dict[str, Dict[int, Any]]] = None,
+        subnetworks_only: bool = False,
+        kv=None,
+        forget_below: Optional[Callable[[int], None]] = None,
+    ) -> ElasticRunResult:
+        """Drains the iteration's work queue; returns the host state.
+
+        `state` must be host-resident and identical on every process
+        (deterministic init / checkpoint restore). `first_global_step`
+        is the absolute batch index of this ITERATION's step 0, so
+        re-issued units replay the exact batches their first execution
+        consumed. The chief publishes units and owns the ensemble
+        windows; every process (chief included) pulls candidate units.
+        `warm_states` grafts speculatively pre-trained windows in as
+        instant completions (see docs/scheduler.md, speculation).
+        `forget_below` (absolute step index) is called as the drain's
+        re-issue floor rises, letting the caller's batch log drop
+        batches no unsettled unit can ever replay — without it the log
+        retains the whole iteration's batches until the next drain.
+        """
+        state = self.place(state)
+        kv = kv or self._injected_kv
+        if kv is None:
+            kv = coordination_kv() if self._process_count > 1 else InMemoryKV()
+        config = self.elastic_strategy.queue_config()
+        queue = WorkQueue(
+            kv,
+            queue_namespace,
+            config,
+            worker="p%d" % self._process_index,
+            clock=self._clock,
+        )
+
+        entry_steps = {
+            name: int(jax.device_get(st.step))
+            for name, st in state.subnetworks.items()
+        }
+        ens_entry = int(jax.device_get(state.iteration_step))
+        caps = self._candidate_caps(target_steps)
+        # Local state cache: (name, end_step) -> host SubnetworkTrainState;
+        # (ENSEMBLE, end_step) -> (ensembles, candidates).
+        states: Dict[Tuple[str, int], Any] = {
+            (name, step): state.subnetworks[name]
+            for name, step in entry_steps.items()
+        }
+        states[(ENSEMBLE, ens_entry)] = (state.ensembles, state.candidates)
+        frozen_dev = mesh_lib.replicate_state(state.frozen, self._unit_mesh)
+
+        if self.is_chief:
+            units = self.plan_units(
+                state, target_steps, subnetworks_only=subnetworks_only
+            )
+            queue.publish(units)
+            reused = self._graft_warm_states(queue, states, warm_states)
+        else:
+            queue.load(timeout_secs=self._batch_timeout)
+            reused = 0
+
+        unit_index = {
+            (u.name, u.end_step): u for u in queue.units
+        }
+
+        def ready(unit: WorkUnit) -> bool:
+            return self._unit_ready(
+                unit, queue, unit_index, entry_steps, ens_entry, caps
+            )
+
+        def can_run(unit: WorkUnit) -> bool:
+            # Ensemble windows are pinned to the chief: selection state
+            # (EMAs, mixture weights) lives where bookkeeping happens.
+            return unit.kind != "ensemble" or self.is_chief
+
+        base_rng = state.rng
+        dispatched = 0
+        metrics: Dict[str, Any] = {}
+        completed = True
+        stall_deadline = self._clock() + config.drain_timeout_secs
+        while not queue.drained():
+            if should_stop is not None and should_stop():
+                completed = False
+                break
+            claim = queue.claim(ready, can_run)
+            if claim is None:
+                if self._clock() > stall_deadline:
+                    raise PeerLostError(
+                        "work-queue drain",
+                        timeout_secs=config.drain_timeout_secs,
+                        detail="no claimable unit and no completion in "
+                        "namespace %s (dead chief or wedged peer?)"
+                        % queue.namespace,
+                    )
+                time.sleep(config.poll_interval_secs)
+                continue
+            unit, attempt = claim
+            stall_deadline = self._clock() + config.drain_timeout_secs
+            try:
+                with LeaseRenewer(queue, unit, attempt):
+                    if unit.kind == "subnetwork":
+                        state_in = self._input_state(
+                            unit, queue, states, unit_index, entry_steps
+                        )
+                        out, unit_metrics = self._run_subnetwork_unit(
+                            unit, state_in, base_rng, batch_at,
+                            first_global_step,
+                        )
+                        blob = (
+                            encode_tree(out)
+                            if self._process_count > 1
+                            else None
+                        )
+                    else:
+                        ens_in = self._input_state(
+                            unit, queue, states, unit_index, entry_steps
+                        )
+                        member_vars = self._member_vars_for(
+                            unit, queue, states, unit_index, entry_steps,
+                            caps,
+                        )
+                        out, unit_metrics = self._run_ensemble_unit(
+                            unit, ens_in, member_vars, frozen_dev,
+                            batch_at, first_global_step,
+                        )
+                        blob = None  # ensemble windows never leave the chief
+            except CANDIDATE_FAULTS as exc:
+                if unit.kind == "ensemble":
+                    raise  # selection state cannot be quarantined away
+                _LOG.error(
+                    "Work unit %s faulted on attempt %d: %s",
+                    unit.uid,
+                    attempt,
+                    exc,
+                )
+                queue.release(unit, attempt)
+                continue
+            dispatched += unit.num_steps
+            states[(unit.name, unit.end_step)] = out
+            queue.complete(unit, attempt, blob)
+            metrics.update(unit_metrics)
+            if forget_below is not None:
+                # Only unsettled units can still be (re-)issued; batches
+                # below the lowest unsettled start are dead weight. The
+                # refresh folds in the completion just published (and
+                # any peer's) before the floor is computed.
+                queue.refresh()
+                live = [
+                    u.start_step
+                    for u in queue.units
+                    if not queue.settled(u)
+                ]
+                forget_below(
+                    first_global_step
+                    + (min(live) if live else int(target_steps))
+                )
+
+        # ------------------------------------------------------- assembly
+        if not self.is_chief:
+            queue.refresh()
+            return ElasticRunResult(
+                state=None,
+                steps_trained=self._ensemble_progress(queue, ens_entry)
+                - ens_entry,
+                completed=completed,
+                dispatched_steps=dispatched,
+                reused_steps=reused,
+                metrics=metrics,
+            )
+        final = self._assemble(
+            state, queue, states, unit_index, entry_steps, ens_entry, caps
+        )
+        steps_trained = int(final.iteration_step) - ens_entry
+        for name, reason in self._poisoned_now(queue).items():
+            if name not in self._dead_subnetworks:
+                self._mark_subnetwork_dead(name, RuntimeError(reason))
+        window_states: Dict[str, Dict[int, Any]] = {}
+        for (name, end), value in states.items():
+            if name != ENSEMBLE and end > entry_steps.get(name, 0):
+                window_states.setdefault(name, {})[end] = value
+        return ElasticRunResult(
+            state=final,
+            steps_trained=steps_trained,
+            completed=completed,
+            dispatched_steps=dispatched,
+            reused_steps=reused,
+            metrics=metrics,
+            window_states=window_states,
+        )
+
+    # ------------------------------------------------------- drain helpers
+
+    def _graft_warm_states(self, queue, states, warm_states) -> int:
+        """Marks speculatively pre-trained windows done (chief-only)."""
+        if not warm_states:
+            return 0
+        reused = 0
+        for unit in queue.units:
+            if unit.kind != "subnetwork":
+                continue
+            warm = warm_states.get(unit.name, {})
+            if unit.end_step in warm and not queue.is_done(unit):
+                out = warm[unit.end_step]
+                states[(unit.name, unit.end_step)] = out
+                blob = (
+                    encode_tree(out) if self._process_count > 1 else None
+                )
+                # complete() needs a claim for bookkeeping symmetry.
+                queue._kv.set(
+                    queue._key("claim", unit.uid, 0),
+                    queue._claim_token(),
+                    overwrite=False,
+                )
+                queue.complete(unit, 0, blob)
+                reused += unit.num_steps
+        if reused:
+            _LOG.info(
+                "Speculative warm start reused %d pre-trained steps.",
+                reused,
+            )
+        return reused
+
+    @staticmethod
+    def _ensemble_progress(queue, ens_entry: int) -> int:
+        ens_end = ens_entry
+        for unit in queue.units:
+            if unit.kind == "ensemble" and queue.is_done(unit):
+                ens_end = max(ens_end, unit.end_step)
+        return ens_end
+
+    def _poisoned_now(self, queue) -> Dict[str, str]:
+        return {
+            spec.name: queue.poisoned(spec.name)
+            for spec in self.iteration.subnetwork_specs
+            if queue.poisoned(spec.name) is not None
+        }
+
+    def _member_need(
+        self, name, window_end, queue, unit_index, entry_steps, caps
+    ) -> Optional[int]:
+        """The member end_step an ensemble window ending at `window_end`
+        consumes for candidate `name`; None when not yet available."""
+        target = min(caps[name], window_end)
+        if target <= entry_steps[name]:
+            return entry_steps[name]
+        if queue.poisoned(name) is not None:
+            return queue.final_step(name, entry_steps[name])
+        unit = unit_index.get((name, target))
+        if unit is None:  # resumed run: state restored beyond this point
+            return entry_steps[name]
+        return target if queue.is_done(unit) else None
+
+    def _unit_ready(
+        self, unit, queue, unit_index, entry_steps, ens_entry, caps
+    ) -> bool:
+        if unit.kind == "subnetwork":
+            if unit.start_step <= entry_steps[unit.name]:
+                return True
+            prev = unit_index.get((unit.name, unit.start_step))
+            return prev is not None and queue.is_done(prev)
+        # Ensemble window: its own predecessor plus every member state.
+        if unit.start_step > ens_entry:
+            prev = unit_index.get((ENSEMBLE, unit.start_step))
+            if prev is None or not queue.is_done(prev):
+                return False
+        for spec in self.iteration.subnetwork_specs:
+            if (
+                self._member_need(
+                    spec.name, unit.end_step, queue, unit_index,
+                    entry_steps, caps,
+                )
+                is None
+            ):
+                return False
+        return True
+
+    def _input_state(self, unit, queue, states, unit_index, entry_steps):
+        """The unit's predecessor state, fetched over KV when another
+        process produced it."""
+        if unit.kind == "subnetwork":
+            key = (unit.name, unit.start_step)
+            template = self._host_template.subnetworks[unit.name]
+        else:
+            key = (ENSEMBLE, unit.start_step)
+            template = (
+                self._host_template.ensembles,
+                self._host_template.candidates,
+            )
+        if key in states:
+            return states[key]
+        prev = unit_index[key]
+        blob = queue.read_blob(prev, timeout_secs=self._batch_timeout)
+        states[key] = decode_tree(template, blob)
+        return states[key]
+
+    def _member_state(
+        self, name, end_step, queue, states, unit_index, entry_steps
+    ):
+        key = (name, end_step)
+        if key in states:
+            return states[key]
+        unit = unit_index[key]
+        blob = queue.read_blob(unit, timeout_secs=self._batch_timeout)
+        states[key] = decode_tree(
+            self._host_template.subnetworks[name], blob
+        )
+        return states[key]
+
+    def _member_vars_for(
+        self, unit, queue, states, unit_index, entry_steps, caps
+    ):
+        member_vars = {}
+        for spec in self.iteration.subnetwork_specs:
+            need = self._member_need(
+                spec.name, unit.end_step, queue, unit_index, entry_steps,
+                caps,
+            )
+            st = self._member_state(
+                spec.name, need, queue, states, unit_index, entry_steps
+            )
+            member_vars[spec.name] = st.variables
+        return member_vars
+
+    def _assemble(
+        self, state, queue, states, unit_index, entry_steps, ens_entry, caps
+    ):
+        """The iteration's host state after the drain (chief-only)."""
+        from adanet_tpu.core.iteration import IterationState
+
+        import jax.numpy as jnp
+
+        sub_states = {}
+        for spec in self.iteration.subnetwork_specs:
+            name = spec.name
+            if queue.poisoned(name) is not None:
+                end = queue.final_step(name, entry_steps[name])
+            else:
+                end = queue.last_completed_step(name, entry_steps[name])
+            sub_states[name] = self._member_state(
+                name, end, queue, states, unit_index, entry_steps
+            )
+        ens_end = self._ensemble_progress(queue, ens_entry)
+        # The chief executed every ensemble window itself, so the final
+        # (ensembles, candidates) pair is always in the local cache.
+        ens, cands = states[(ENSEMBLE, ens_end)]
+        return IterationState(
+            subnetworks=sub_states,
+            ensembles=ens,
+            candidates=cands,
+            frozen=state.frozen,
+            iteration_step=jnp.asarray(ens_end, jnp.int32),
+            rng=state.rng,
+        )
+
+
+# ------------------------------------------------ generic callable drain
+
+
+def drain_callables(
+    make_units,
+    num_workers: int,
+    devices=None,
+    config: Optional[WorkQueueConfig] = None,
+    kv=None,
+) -> None:
+    """Runs an iterator of zero-arg callables (with barrier sentinels)
+    through the lease-based queue on a thread pool.
+
+    The engine behind `experimental.ParallelScheduler` (now a thin shim):
+    units are claimed under leases in published order, each executing
+    with `jax.default_device` pinned to one device of the pool, and a
+    `None` sentinel in the stream is a BARRIER — all in-flight units
+    drain before later units publish (the phase-chaining contract).
+    Exceptions propagate to the caller after the drain, first one wins.
+
+    In-process threads cannot die independently of the process — every
+    callable either completes or raises, and both paths publish the
+    set-once done/ marker — so the lease TTL is pinned effectively
+    eternal: expiry here could only ever DOUBLE-execute a non-idempotent
+    callable (a GIL-starved renewal heartbeat) or silently poison-drop
+    it after `max_attempts`, failure modes the cross-process queue needs
+    and a same-process pool does not.
+    """
+    config = config or WorkQueueConfig()
+    config = dataclasses.replace(
+        config,
+        lease_ttl_secs=max(config.lease_ttl_secs, _IN_PROCESS_LEASE_TTL),
+    )
+    kv = kv or InMemoryKV()
+    devices = list(devices) if devices is not None else jax.devices()
+    errors: List[BaseException] = []
+    error_lock = threading.Lock()
+
+    phase = [0]
+
+    def run_phase(callables: List[Callable[[], None]]) -> None:
+        if not callables:
+            return
+        phase[0] += 1
+        wq = WorkQueue(
+            kv,
+            "adanet/callables/%d" % phase[0],
+            config,
+            worker="pool",
+        )
+        wq.publish(
+            [
+                WorkUnit("subnetwork", "unit%d" % i, 0, 1)
+                for i in range(len(callables))
+            ]
+        )
+
+        def worker(worker_index: int) -> None:
+            wq_local = WorkQueue(
+                kv,
+                wq.namespace,
+                config,
+                worker="w%d" % worker_index,
+            )
+            wq_local.attach(wq.units)
+            device = devices[worker_index % len(devices)]
+            while True:
+                with error_lock:
+                    if errors:
+                        return
+                claim = wq_local.claim(lambda u: True, lambda u: True)
+                if claim is None:
+                    if wq_local.drained():
+                        return
+                    time.sleep(config.poll_interval_secs)
+                    continue
+                unit, attempt = claim
+                index = int(unit.name[len("unit"):])
+                try:
+                    with LeaseRenewer(wq_local, unit, attempt):
+                        with jax.default_device(device):
+                            callables[index]()
+                except BaseException as exc:  # surfaced after the drain
+                    with error_lock:
+                        errors.append(exc)
+                    wq_local.complete(unit, attempt, None)
+                    return
+                wq_local.complete(unit, attempt, None)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(min(num_workers, len(callables)))
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            # Bounded join (JL009) in a liveness loop: slow callables
+            # hold their (eternal) lease, so the wait simply re-arms
+            # until the worker thread exits — it cannot exit without
+            # first publishing its unit's done/ marker.
+            while thread.is_alive():
+                thread.join(timeout=60.0)
+        if errors:
+            raise errors[0]
+
+    batch: List[Callable[[], None]] = []
+    for item in make_units:
+        if item is None:  # barrier
+            run_phase(batch)
+            batch = []
+            continue
+        batch.append(item)
+    run_phase(batch)

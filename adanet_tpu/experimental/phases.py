@@ -704,14 +704,16 @@ class InProcessScheduler(Scheduler):
 class ParallelScheduler(Scheduler):
     """Runs a phase's work units concurrently, one device group each.
 
-    The distributed scheduler the reference left as unimplemented intent
-    (reference: adanet/experimental/schedulers/scheduler.py — only the
-    in-process one exists; SURVEY §2.7). Each worker thread pins its
-    units' computations to one device of a disjoint group via
-    `jax.default_device`, so independent model fits overlap across the
-    mesh exactly like RoundRobin candidate training in the core engine.
-    `PhaseBarrier`s drain in-flight units, preserving the phase-chaining
-    contract (later phases read earlier phases' storages).
+    Now a thin shim over the core engine's lease-based work queue
+    (`adanet_tpu.distributed.scheduler.drain_callables`): units are
+    claimed in published order under TTL leases renewed by heartbeat,
+    each executing with `jax.default_device` pinned to one device of the
+    pool, so independent model fits overlap across the mesh exactly like
+    elastic candidate training in the core engine. `PhaseBarrier`s
+    become queue barriers — all in-flight units drain before later
+    phases' units publish, preserving the phase-chaining contract (later
+    phases read earlier phases' storages). Exceptions surface to the
+    caller after the drain.
     """
 
     def __init__(self, num_workers: Optional[int] = None, devices=None):
@@ -719,31 +721,21 @@ class ParallelScheduler(Scheduler):
         self._num_workers = num_workers
 
     def schedule(self, work_units: Iterator[WorkUnit]) -> None:
-        import concurrent.futures
+        from adanet_tpu.distributed.scheduler import drain_callables
 
         devices = (
             self._devices if self._devices is not None else jax.devices()
         )
         num_workers = self._num_workers or len(devices)
 
-        def run_on(device, work_unit):
-            with jax.default_device(device):
-                work_unit.execute()
-
-        with concurrent.futures.ThreadPoolExecutor(num_workers) as pool:
-            pending = []
-            index = 0
+        def stream():
             for work_unit in work_units:
-                if isinstance(work_unit, PhaseBarrier):
-                    for future in pending:
-                        future.result()  # surface worker exceptions
-                    pending = []
-                    continue
-                device = devices[index % len(devices)]
-                index += 1
-                pending.append(pool.submit(run_on, device, work_unit))
-            for future in pending:
-                future.result()
+                # None is drain_callables' barrier sentinel.
+                yield None if isinstance(work_unit, PhaseBarrier) else (
+                    work_unit.execute
+                )
+
+        drain_callables(stream(), num_workers, devices=devices)
 
 
 class ModelSearch:

@@ -185,25 +185,86 @@ def _pallas_forward(x, dw, pw, stride: int, interpret: bool):
 _lowering_ok_cache = {}
 
 
-def _tpu_lowering_ok(x, dw, pw, stride: int) -> bool:
-    """AOT-compiles the kernel for the live TPU at exactly the caller's
-    shapes/dtypes (once per shape signature per process). True when TPU
-    is not this process's default backend: `platform_dependent`'s
-    default branch serves the other platforms, so there is nothing to
-    validate (and a CPU-targeted trace on a TPU host must not pay TPU
-    compiles). LOCAL devices only — under multi-host SPMD every process
-    validates against its own addressable chip, so the verdict (and
-    therefore the traced branch) is identical across processes.
+def _live_mesh():
+    """The `jax.sharding.Mesh` context the caller is tracing under, or
+    None (private-API access tolerated: absence just means global-shape
+    validation, never a crash)."""
+    try:
+        from jax._src.mesh import thread_resources
 
-    CAVEAT (ADVICE r5): the validation happens at the caller's
-    TRACE-time shapes, which under jit + SPMD partitioning are the
-    GLOBAL array shapes; GSPMD then lowers the kernel at PER-SHARD
-    shapes. The guard is therefore exact only for unpartitioned calls
-    (replicated or fully local operands): a partitioned call can pass
-    validation here yet fail the real compile, or be rejected for a
-    global shape whose shards would have lowered fine. Callers
-    partitioning the conv operands should validate the shard shape
-    (global divided by the mesh partitioning) instead."""
+        mesh = thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception:  # pragma: no cover - private-API drift
+        return None
+
+
+def _shard_shapes(x, dw, pw):
+    """The per-shard shapes GSPMD will actually lower the kernel at.
+
+    Two detection sources, first hit wins per operand:
+
+    1. A concrete operand's own sharding (`Sharding.shard_shape`) — the
+       partitioner's exact answer, available on eager / `device_put`
+       operands.
+    2. A live `Mesh` context around the trace: the framework's
+       data-parallel convention (`distributed/mesh.py::shard_batch`) —
+       `x`'s leading batch axis shards over the `data` axis iff evenly
+       divisible (uneven batches replicate), conv weights replicate.
+
+    Operands that are plain-`jit` tracers outside any mesh context carry
+    no sharding on this jax and fall through to their global shapes —
+    the residual caveat documented in `_tpu_lowering_ok`.
+    """
+    mesh = _live_mesh()
+    data_size = None
+    if mesh is not None:
+        axes = dict(mesh.shape)
+        data_size = axes.get("data")
+        if data_size is None:  # non-"data" mesh: full device product
+            size = 1
+            for n in axes.values():
+                size *= int(n)
+            data_size = size
+    shapes = []
+    for i, a in enumerate((x, dw, pw)):
+        shape = tuple(a.shape)
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None:
+            try:
+                shapes.append(tuple(sharding.shard_shape(shape)))
+                continue
+            except Exception:
+                pass  # e.g. shape not partitionable by this sharding
+        if (
+            i == 0
+            and data_size
+            and shape
+            and shape[0] % data_size == 0
+        ):
+            shape = (shape[0] // data_size,) + shape[1:]
+        shapes.append(shape)
+    return tuple(shapes)
+
+
+def _tpu_lowering_ok(x, dw, pw, stride: int) -> bool:
+    """AOT-compiles the kernel for the live TPU at the PER-SHARD
+    shapes/dtypes the partitioner will hand it (once per shape signature
+    per process). True when TPU is not this process's default backend:
+    `platform_dependent`'s default branch serves the other platforms, so
+    there is nothing to validate (and a CPU-targeted trace on a TPU host
+    must not pay TPU compiles). LOCAL devices only — under multi-host
+    SPMD every process validates against its own addressable chip, so
+    the verdict (and therefore the traced branch) is identical across
+    processes.
+
+    Under jit + SPMD partitioning the caller's trace-time shapes are the
+    GLOBAL array shapes while GSPMD lowers the kernel at per-shard
+    shapes, so validation runs on `_shard_shapes` (ADVICE r5): exact for
+    unpartitioned calls, for concrete sharded operands, and for traces
+    inside a live `Mesh` context following the framework's batch-axis
+    data-parallel convention. The residual gap is a partitioned call
+    from a plain-`jit` tracer outside any mesh context (no sharding is
+    observable there) — that still validates at global shapes."""
     try:
         if jax.default_backend() != "tpu":
             return True
@@ -212,18 +273,24 @@ def _tpu_lowering_ok(x, dw, pw, stride: int) -> bool:
         return True
     if not tpus:
         return True
+    x_shape, dw_shape, pw_shape = _shard_shapes(x, dw, pw)
     key = (
-        tuple(x.shape),
+        x_shape,
         str(x.dtype),
-        tuple(dw.shape),
+        dw_shape,
         str(dw.dtype),
-        tuple(pw.shape),
+        pw_shape,
         str(pw.dtype),
         stride,
     )
     ok = _lowering_ok_cache.get(key)
     if ok is None:
-        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in (x, dw, pw)]
+        specs = [
+            jax.ShapeDtypeStruct(shape, a.dtype)
+            for shape, a in zip(
+                (x_shape, dw_shape, pw_shape), (x, dw, pw)
+            )
+        ]
         try:
             with jax.default_device(tpus[0]):
                 jax.jit(

@@ -52,6 +52,8 @@ FAULT_SITES = frozenset(
         "collective.entry",  # distributed/multihost.py host collectives
         "compile_cache.read",  # core/compile_cache.py executable lookup
         "data.pull",  # core/estimator.py training-batch pulls
+        "lease.renew",  # distributed/scheduler.py work-unit lease renewal
+        "workunit.execute",  # distributed/scheduler.py unit execution entry
     }
 )
 

@@ -39,6 +39,13 @@ _LOG = logging.getLogger("adanet_tpu")
 
 STALE_SUFFIX = ".stale"
 
+#: Exit-code contract shared by `tools/ckpt_fsck.py`, CI, and the
+#: elastic scheduler's pre-restore check (usage errors exit 64/EX_USAGE
+#: so 2 is unambiguous).
+EXIT_CLEAN = 0
+EXIT_HEALED = 1
+EXIT_UNRECOVERABLE = 2
+
 
 @dataclasses.dataclass
 class FsckReport:
@@ -54,6 +61,37 @@ class FsckReport:
     manifest_rewritten: bool = False
     info: Optional[ckpt.CheckpointInfo] = None
 
+    @property
+    def verdict(self) -> str:
+        """"clean" | "healed" | "unrecoverable".
+
+        Deterministic given the dir contents whether or not `repair` ran
+        (report-only mode computes the identical rollback), so CI's
+        verify pass and the chief's heal pass agree. "healed" means a
+        usable resume point survives the (actual or would-be) repair;
+        "unrecoverable" means the heal rolls all the way back to
+        iteration 0 / global step 0 — every trained generation was lost
+        and resuming is training from scratch.
+        """
+        if self.ok or self.fresh:
+            return "clean"
+        if (
+            self.rolled_back_to_iteration == 0
+            and not self.rolled_back_global_step
+            and self.info is not None
+            and self.info.iteration_state_file is None
+        ):
+            return "unrecoverable"
+        return "healed"
+
+    @property
+    def exit_code(self) -> int:
+        return {
+            "clean": EXIT_CLEAN,
+            "healed": EXIT_HEALED,
+            "unrecoverable": EXIT_UNRECOVERABLE,
+        }[self.verdict]
+
     def to_json(self) -> dict:
         obj = dataclasses.asdict(self)
         info = obj.pop("info")
@@ -61,6 +99,8 @@ class FsckReport:
             obj["iteration_number"] = info["iteration_number"]
             obj["global_step"] = info["global_step"]
             obj["generation"] = info["generation"]
+        obj["verdict"] = self.verdict
+        obj["exit_code"] = self.exit_code
         return obj
 
 

@@ -1,0 +1,178 @@
+"""Elastic work-queue runner: lease-based multi-process candidate search.
+
+Spawned by `test_distributed.py::test_elastic_wq_grow_back_oracle_parity`
+(2→1→2 with selection parity against a never-shrunk oracle) and
+`test_robustness.py::test_elastic_wq_worker_sigkill_mid_unit` (a worker
+SIGKILLed mid-work-unit by the armed `workunit.execute` fault; the lease
+expires and the chief re-runs the unit). One invocation runs one phase:
+
+    elastic_wq_runner.py <model_dir> <tag> <process_id> <port> <world> <max_steps>
+
+Unlike the SPMD runners, every process feeds the IDENTICAL full batch
+stream — the elastic scheduler's data contract: a work unit's batches
+are a pure function of its absolute step indices, so a unit re-issued to
+a survivor (or replayed in a different world size) consumes exactly the
+same data. Combined with `unit_devices=1` (unit numerics depend only on
+the unit submesh size) the whole search is bit-identical across 1- and
+2-process topologies — no device collectives exist to reorder a psum,
+which is what un-skips the jaxlib<0.5 grow-back parity scenario gated at
+`test_distributed.py::_GLOO_UNFRAMED_PAIR`.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def full_batches():
+    """Deterministic 16-row batches, identical on every process."""
+    rng = np.random.RandomState(7)
+    while True:
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x @ np.ones((4, 1), np.float32)) + 0.1
+        yield {"x": x}, y
+
+
+def selection_sequence(model_dir):
+    out = []
+    t = 0
+    while True:
+        path = os.path.join(model_dir, "architecture-%d.json" % t)
+        if not os.path.exists(path):
+            return out
+        with open(path) as f:
+            obj = json.load(f)
+        out.append(
+            (obj.get("ensemble_candidate_name"), obj.get("subnetworks"))
+        )
+        t += 1
+
+
+def main():
+    model_dir, tag, process_id, port, world, max_steps = (
+        sys.argv[1],
+        sys.argv[2],
+        int(sys.argv[3]),
+        sys.argv[4],
+        int(sys.argv[5]),
+        int(sys.argv[6]),
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = os.environ.get(
+            "XLA_FLAGS", ""
+        ) + " --xla_force_host_platform_device_count=1"
+    if world > 1:
+        # The elastic scheduler never runs device collectives; the
+        # distributed runtime is initialized purely for the
+        # coordination-service KV store the queue lives on.
+        jax.distributed.initialize(
+            coordinator_address="localhost:%s" % port,
+            num_processes=world,
+            process_id=process_id,
+        )
+        assert jax.process_count() == world
+
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.distributed import ElasticWorkQueueStrategy
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder
+
+    if os.environ.get("TEST_PLACEMENT") == "rr":
+        # Lockstep RoundRobin oracle: with one local device the
+        # candidate submeshes and the elastic unit submeshes are the
+        # same 1-device mesh, so the two drives train bit-identical
+        # trajectories — the parity the chaos tests assert. The oracle
+        # must run the SAME 4-step window cadence as the elastic drive
+        # (iterations_per_loop == window_steps): a windowed dispatch
+        # syncs member params once per window (end-of-window states,
+        # exactly `_member_need`'s contract), while single-step lockstep
+        # would sync every step and walk a different — equally valid but
+        # non-comparable — candidate-EMA trajectory.
+        from adanet_tpu.distributed import RoundRobinStrategy
+
+        placement = RoundRobinStrategy()
+    else:
+        placement = ElasticWorkQueueStrategy(
+            window_steps=4,
+            unit_devices=1,
+            lease_ttl_secs=float(os.environ.get("TEST_LEASE_TTL", "3")),
+        )
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.RegressionHead(),
+        subnetwork_generator=SimpleGenerator(
+            [
+                DNNBuilder("d1", hidden=4, learning_rate=0.05),
+                DNNBuilder("d2", hidden=8, learning_rate=0.05),
+            ]
+        ),
+        max_iteration_steps=20,
+        max_iterations=2,
+        iterations_per_loop=4,
+        ensemblers=[ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))],
+        model_dir=model_dir,
+        log_every_steps=0,
+        placement_strategy=placement,
+    )
+
+    start_step = est.latest_global_step()
+    est.train(
+        lambda: iter(full_batches()),
+        max_steps=None if max_steps < 0 else max_steps,
+    )
+    record = {
+        "resume_start_step": start_step,
+        "final_step": est.latest_global_step(),
+        "final_iteration": est.latest_iteration_number(),
+        "world": world,
+    }
+    if max_steps < 0 and process_id == 0:
+        metrics = est.evaluate(lambda: iter(full_batches()), steps=4)
+        record["loss"] = float(metrics["loss"])
+        record["selection"] = selection_sequence(model_dir)
+    if process_id == 0:
+        with open(os.path.join(model_dir, "%s.json" % tag), "w") as f:
+            json.dump(record, f)
+    print("ELASTIC WQ ROLE %d DONE" % process_id, flush=True)
+    if world > 1 and os.environ.get("ADANET_TEST_EXIT_BARRIER"):
+        # Exit rendezvous over the work queue's own KV store: the
+        # coordination service lives inside process 0, so if the chief
+        # exits while a peer's agent is still polling it, the peer
+        # FATALs with "Socket closed" (jaxlib 0.4.x). Workers flag done
+        # and exit at once; the chief leaves only after every flag.
+        # Opt-in: the SIGKILL chaos scenario must NOT have the chief
+        # wait on a flag its dead worker can never set.
+        from adanet_tpu.distributed.scheduler import coordination_kv
+
+        kv = coordination_kv()
+        if process_id != 0:
+            kv.set("adanet/exit/%s/%d" % (tag, process_id), "1")
+        else:
+            for peer in range(1, world):
+                try:
+                    kv.get(
+                        "adanet/exit/%s/%d" % (tag, peer),
+                        timeout_secs=120.0,
+                    )
+                except Exception as exc:  # bounded: exit anyway
+                    print("exit barrier: peer %d missing (%s)" % (peer, exc))
+    # Skip the atexit jax.distributed shutdown barrier: in the chaos
+    # scenarios a SIGKILLed peer can never join it, and on this jaxlib
+    # the failed barrier FATALs the (successful) survivor at exit.
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""JL009 bad: unbounded KV-store/coordination waits."""
+
+import threading
+
+from jax._src import distributed
+
+
+def fetch_forever(key):
+    client = distributed.global_state.client
+    return client.blocking_key_value_get(key)  # expect: JL009
+
+
+def fetch_bytes_forever(key):
+    client = distributed.global_state.client
+    return client.blocking_key_value_get_bytes(key)  # expect: JL009
+
+
+def barrier_forever(client):
+    client.wait_at_barrier("iteration-0")  # expect: JL009
+
+
+def wait_on_peer(event: threading.Event):
+    event.wait()  # expect: JL009
+
+
+def reap(worker: threading.Thread, proc):
+    worker.join()  # expect: JL009
+    proc.wait()  # expect: JL009

@@ -1,0 +1,35 @@
+"""JL009 good: every coordination wait carries a bound."""
+
+import os
+import threading
+
+from jax._src import distributed
+
+
+def fetch_bounded(key, timeout_ms):
+    client = distributed.global_state.client
+    return client.blocking_key_value_get(key, timeout_ms)
+
+
+def fetch_bytes_kwarg(key):
+    client = distributed.global_state.client
+    return client.blocking_key_value_get_bytes(key, timeout_in_ms=5000)
+
+
+def barrier_bounded(client):
+    client.wait_at_barrier("iteration-0", 30_000)
+
+
+def wait_with_deadline(event: threading.Event) -> bool:
+    return event.wait(timeout=10.0)
+
+
+def reap_bounded(worker: threading.Thread, proc):
+    worker.join(5.0)
+    proc.wait(timeout=60)
+
+
+def string_building(parts):
+    # str/bytes receivers and arg-carrying joins never block on a peer.
+    joined = ", ".join(parts)
+    return os.path.join("a", joined)

@@ -161,7 +161,8 @@ def test_worker_wait_for_iteration(tmp_path):
     info = wait_for_iteration(
         model_dir, 1, timeout_secs=10.0, poll_interval_secs=0.05
     )
-    thread.join()
+    thread.join(timeout=10.0)
+    assert not thread.is_alive()
     assert info.iteration_number == 1
     assert info.global_step == 8
 
@@ -863,6 +864,101 @@ def test_elastic_grow_back_resume(tmp_path):
     oracle_dir = str(tmp_path / "oracle_model")
     os.makedirs(oracle_dir)
     oracle = _run_elastic_phase(oracle_dir, "oracle", world=1, max_steps=-1)
+    assert phase_c["selection"], phase_c
+    assert phase_c["selection"] == oracle["selection"], (
+        phase_c["selection"],
+        oracle["selection"],
+    )
+
+
+def _run_elastic_wq_phase(model_dir, tag, world, max_steps, timeout=600):
+    """Spawns `world` elastic_wq_runner.py processes for one phase of a
+    lease-based elastic search and returns the record process 0 wrote."""
+    import json
+    import socket
+    import subprocess
+    import sys
+
+    runner = os.path.join(os.path.dirname(__file__), "elastic_wq_runner.py")
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        port = sock.getsockname()[1]
+
+    def spawn(index):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        # All peers survive in this scenario: rendezvous before exit so
+        # the chief cannot tear down the coordination service while a
+        # worker's agent still polls it (fatal on jaxlib 0.4.x).
+        env["ADANET_TEST_EXIT_BARRIER"] = "1"
+        tests_dir = os.path.dirname(__file__)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(tests_dir), tests_dir, env.get("PYTHONPATH", "")]
+        )
+        return subprocess.Popen(
+            [
+                sys.executable,
+                runner,
+                model_dir,
+                tag,
+                str(index),
+                str(port),
+                str(world),
+                str(max_steps),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    procs = [spawn(i) for i in range(world)]
+    for i, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=timeout)
+        assert proc.returncode == 0, (tag, i, out.decode()[-3000:])
+        assert b"DONE" in out
+    with open(os.path.join(model_dir, "%s.json" % tag)) as f:
+        return json.load(f)
+
+
+def test_elastic_wq_grow_back_oracle_parity(tmp_path):
+    """ISSUE 6 satellite: the 2→1→2 grow-back oracle-parity scenario,
+    UN-skipped on jaxlib<0.5. The SPMD variant above
+    (`test_elastic_grow_back_resume`) is version-gated by
+    `_GLOO_UNFRAMED_PAIR` because its cross-process psums reorder sums;
+    the lease-based work queue moves control plane AND state transfer
+    onto the coordination-service KV store — no device collectives
+    exist, so nothing can abort gloo or reorder a reduction, and the
+    selection sequence is bit-identical across 2-proc, shrunk 1-proc,
+    and grown-back 2-proc worlds (work units train on the same 1-device
+    unit submesh everywhere)."""
+    model_dir = str(tmp_path / "elastic_wq_model")
+    os.makedirs(model_dir)
+
+    # 2 procs, budget-stopped mid-iteration 0 at an off-grid step.
+    phase_a = _run_elastic_wq_phase(model_dir, "phase_a", world=2, max_steps=8)
+    assert (phase_a["final_step"], phase_a["final_iteration"]) == (8, 0)
+
+    # Shrunk world: 1 proc continues across the iteration boundary.
+    phase_b = _run_elastic_wq_phase(
+        model_dir, "phase_b", world=1, max_steps=28
+    )
+    assert phase_b["resume_start_step"] == 8
+    assert (phase_b["final_step"], phase_b["final_iteration"]) == (28, 1)
+
+    # Grown back: 2 procs finish the search.
+    phase_c = _run_elastic_wq_phase(
+        model_dir, "phase_c", world=2, max_steps=-1
+    )
+    assert phase_c["resume_start_step"] == 28
+    assert phase_c["final_step"] == 40
+    assert phase_c["final_iteration"] == 2
+    assert np.isfinite(phase_c["loss"])
+
+    # Never-shrunk single-world oracle over the same global stream.
+    oracle_dir = str(tmp_path / "oracle_model")
+    os.makedirs(oracle_dir)
+    oracle = _run_elastic_wq_phase(oracle_dir, "oracle", world=1, max_steps=-1)
     assert phase_c["selection"], phase_c
     assert phase_c["selection"] == oracle["selection"], (
         phase_c["selection"],

@@ -107,6 +107,124 @@ def test_retry_bounded_and_deterministic():
     assert not retry.is_transient(ckpt_lib.CheckpointCorruptionError("p", "r"))
 
 
+def test_retry_backoff_schedule_caps_at_max_delay():
+    """ISSUE 6 satellite: the full deterministic backoff schedule under a
+    mocked sleep — exponential doubling capped at `max_delay`, identical
+    on every run (no jitter), honoring a custom `retry_on`."""
+    delays = []
+    calls = [0]
+
+    def always_flaky():
+        calls[0] += 1
+        raise faults.InjectedTransientError("hiccup %d" % calls[0])
+
+    with pytest.raises(faults.InjectedTransientError):
+        retry.with_retries(always_flaky, attempts=8, sleep=delays.append)
+    # 7 sleeps between 8 attempts; the cap flattens the tail.
+    assert delays == [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0]
+    assert calls[0] == 8
+
+    # Custom schedule knobs are respected exactly.
+    delays.clear()
+    calls[0] = 0
+    with pytest.raises(faults.InjectedTransientError):
+        retry.with_retries(
+            always_flaky,
+            attempts=4,
+            base_delay=1.0,
+            multiplier=3.0,
+            max_delay=5.0,
+            sleep=delays.append,
+        )
+    assert delays == [1.0, 3.0, 5.0]
+
+    # A custom retry_on can widen the transient set; the bound holds.
+    delays.clear()
+    with pytest.raises(KeyError):
+        retry.with_retries(
+            lambda: (_ for _ in ()).throw(KeyError("x")),
+            attempts=3,
+            retry_on=lambda exc: isinstance(exc, KeyError),
+            sleep=delays.append,
+        )
+    assert len(delays) == 2
+
+    with pytest.raises(ValueError):
+        retry.with_retries(lambda: None, attempts=0)
+
+
+def test_heartbeat_staleness_threshold_boundary(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: the staleness comparison under a mocked clock —
+    a heartbeat EXACTLY at the threshold is still live (strict `>`), one
+    tick past it declares the chief lost. No sleeps, no wall-clock
+    flake: `watchdog.time` is a fake namespace and the beat file's mtime
+    is set explicitly."""
+    import types
+
+    from adanet_tpu.distributed import coordination
+
+    d = str(tmp_path)
+    path = watchdog.heartbeat_path(d)
+    with open(path, "w") as f:
+        f.write("{}")
+
+    now = [1_000_000.0]
+    monkeypatch.setattr(
+        watchdog,
+        "time",
+        types.SimpleNamespace(
+            time=lambda: now[0], monotonic=time.monotonic
+        ),
+    )
+    beat = now[0] - 30.0
+    os.utime(path, (beat, beat))
+    assert watchdog.heartbeat_age(d) == pytest.approx(30.0)
+
+    # Age == threshold: NOT stale — the plain countdown runs out instead.
+    with pytest.raises(coordination.WorkerWaitTimeout):
+        coordination.wait_for_iteration(
+            d,
+            1,
+            timeout_secs=0.15,
+            poll_interval_secs=0.05,
+            heartbeat_timeout_secs=30.0,
+        )
+
+    # One tick past the threshold: PeerLostError, immediately.
+    now[0] += 0.5
+    with pytest.raises(watchdog.PeerLostError) as err:
+        coordination.wait_for_iteration(
+            d,
+            1,
+            timeout_secs=60.0,
+            poll_interval_secs=0.05,
+            heartbeat_timeout_secs=30.0,
+        )
+    assert err.value.source_process == 0
+
+    # A fresh beat (renewal) re-arms the threshold — the lease-renewal
+    # analogue: heartbeats bound staleness, not total duration.
+    now[0] += 1000.0
+    os.utime(path, (now[0] - 1.0, now[0] - 1.0))
+    with pytest.raises(coordination.WorkerWaitTimeout):
+        coordination.wait_for_iteration(
+            d,
+            1,
+            timeout_secs=0.15,
+            poll_interval_secs=0.05,
+            heartbeat_timeout_secs=30.0,
+        )
+
+
+def test_lease_renew_interval_tracks_ttl():
+    """The scheduler's heartbeat period is TTL/3 with a 50ms floor, so a
+    single missed beat never expires a live worker's lease."""
+    from adanet_tpu.distributed import WorkQueueConfig
+
+    assert WorkQueueConfig(lease_ttl_secs=15.0).renew_interval_secs == 5.0
+    assert WorkQueueConfig(lease_ttl_secs=0.01).renew_interval_secs == 0.05
+
+
 # ------------------------------------------------------------- checkpoints
 
 
@@ -509,6 +627,45 @@ def test_fsck_rolls_back_corrupt_frozen_generation(oracle_dir, tmp_path):
     assert _arch(d, 1) == _arch(oracle_dir, 1)
 
 
+def test_fsck_exit_codes_and_json_verdict(oracle_dir, tmp_path, capsys):
+    """The CLI contract CI and the scheduler's pre-restore check consume:
+    0 clean / 1 healed / 2 unrecoverable (64 usage), with the same
+    answer in the --json report's verdict/exit_code fields, identical
+    with and without --repair."""
+    from tools import ckpt_fsck
+
+    # Healed: frozen-1 rots; iteration 0's generation survives.
+    d = str(tmp_path / "healed")
+    shutil.copytree(oracle_dir, d)
+    with open(os.path.join(d, "frozen-1.msgpack"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\x00\x01\x02\x03")
+    assert ckpt_fsck.main([d, "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert (report["verdict"], report["exit_code"]) == ("healed", 1)
+    assert ckpt_fsck.main([d, "--repair", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "healed" and report["manifest_rewritten"]
+    assert ckpt_fsck.main([d]) == 0  # repair converged: now clean
+    capsys.readouterr()  # drain the non-JSON "clean:" line
+
+    # Unrecoverable: frozen-0 rots -> rollback to iteration 0, step 0.
+    d = str(tmp_path / "lost")
+    shutil.copytree(oracle_dir, d)
+    with open(os.path.join(d, "frozen-0.msgpack"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\x00\x01\x02\x03")
+    assert ckpt_fsck.main([d, "--json"]) == 2
+    report = json.loads(capsys.readouterr().out)
+    assert (report["verdict"], report["exit_code"]) == ("unrecoverable", 2)
+    assert report["rolled_back_to_iteration"] == 0
+
+    # Usage errors exit 64, never colliding with "unrecoverable".
+    with pytest.raises(SystemExit) as exc:
+        ckpt_fsck.main(["--no-such-flag"])
+    assert exc.value.code == 64
+
+
 def test_truncated_mid_iteration_state_rolls_back(oracle_dir, tmp_path):
     """A truncated `ckpt-*` the manifest points at degrades to "restart
     the iteration", not a crash — and the search still completes."""
@@ -622,7 +779,7 @@ def test_chaos_multihost_peer_death(torn_model_dir, tmp_path):
         out, _ = chief.communicate(timeout=240)
     finally:
         peer.kill()
-        peer.wait()
+        peer.wait(timeout=60)
     text = out.decode()
     if chief.returncode == -signal.SIGABRT and "preamble" in text:
         pytest.skip(
@@ -659,3 +816,82 @@ def test_chaos_multihost_peer_death(torn_model_dir, tmp_path):
         name for name, entry in metrics.items() if entry["dead"]
     ]
     assert any("a" in name for name in dead_entries)
+
+
+def test_elastic_wq_worker_sigkill_mid_unit(tmp_path):
+    """ISSUE 6 acceptance: SIGKILL a worker mid-work-unit. The armed
+    `workunit.execute:kill` fault SIGKILLs process 1 on its second
+    claimed unit; its lease expires after the 2s TTL, the unit re-issues
+    to the surviving chief, and the elastic search completes the full
+    2-iteration search alone — reaching the lockstep RoundRobin oracle's
+    final ensemble architecture (with one device per process the
+    candidate submeshes and the unit submeshes are the same 1-device
+    mesh, so the drives train the same trajectory)."""
+    d = str(tmp_path / "m")
+    os.makedirs(d)
+    runner = os.path.join(TESTS_DIR, "elastic_wq_runner.py")
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        port = sock.getsockname()[1]
+
+    def spawn(index, extra_env):
+        env = _subprocess_env()
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        env["TEST_LEASE_TTL"] = "2"
+        env.update(extra_env)
+        return subprocess.Popen(
+            [
+                sys.executable,
+                runner,
+                d,
+                "chaos",
+                str(index),
+                str(port),
+                "2",
+                "-1",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    chief = spawn(0, {})
+    worker = spawn(
+        1, {"ADANET_FAULTS": "workunit.execute:kill:after=1"}
+    )
+    try:
+        out, _ = chief.communicate(timeout=420)
+    finally:
+        worker.kill()
+        worker.wait(timeout=60)
+    assert chief.returncode == 0, out.decode()[-3000:]
+    assert worker.returncode == -signal.SIGKILL
+    with open(os.path.join(d, "chaos.json")) as f:
+        record = json.load(f)
+    # No round blocked on the dead peer: the chief finished the WHOLE
+    # search (2 iterations x 20 steps) with the worker gone.
+    assert record["final_step"] == 40
+    assert record["final_iteration"] == 2
+    assert np.isfinite(record["loss"])
+
+    # Lockstep oracle: the same search under RoundRobin placement.
+    d_oracle = str(tmp_path / "oracle")
+    os.makedirs(d_oracle)
+    env = _subprocess_env()
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["TEST_PLACEMENT"] = "rr"
+    proc = subprocess.run(
+        [sys.executable, runner, d_oracle, "oracle", "0", "0", "1", "-1"],
+        env=env,
+        capture_output=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout.decode()[-3000:]
+    with open(os.path.join(d_oracle, "oracle.json")) as f:
+        oracle = json.load(f)
+    assert record["selection"] == oracle["selection"], (
+        record["selection"],
+        oracle["selection"],
+    )

@@ -217,6 +217,63 @@ def test_oversized_example_falls_back_to_xla(monkeypatch):
     )
 
 
+def test_shard_shapes_detect_partitioning():
+    """`_tpu_lowering_ok` validates at PER-SHARD shapes (ADVICE r5): a
+    concrete operand's own sharding answers exactly; a trace inside a
+    live Mesh context follows the framework's batch-axis data-parallel
+    convention (divisible batch shards, weights and uneven batches
+    replicate); unpartitioned calls pass through at global shapes."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from adanet_tpu.ops.sepconv_kernels import _shard_shapes
+
+    x, dw, pw = _random_inputs(8, 8, 8, 8, f=8, k=3)
+    want_global = (tuple(x.shape), tuple(dw.shape), tuple(pw.shape))
+
+    # Unpartitioned: global shapes pass through untouched.
+    assert _shard_shapes(x, dw, pw) == want_global
+
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    n = len(devices)
+
+    # Source 1: concrete sharded operands (device_put) answer exactly.
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("data")))
+    dws = jax.device_put(dw, NamedSharding(mesh, PartitionSpec()))
+    pws = jax.device_put(pw, NamedSharding(mesh, PartitionSpec()))
+    assert _shard_shapes(xs, dws, pws) == (
+        (x.shape[0] // n,) + tuple(x.shape[1:]),
+        tuple(dw.shape),
+        tuple(pw.shape),
+    )
+
+    # Source 2: tracers inside a live mesh context carry no sharding;
+    # the batch-axis convention applies.
+    seen = {}
+
+    def probe(a, b, c):
+        seen["shapes"] = _shard_shapes(a, b, c)
+        return a
+
+    with mesh:
+        jax.eval_shape(probe, x, dw, pw)
+    assert seen["shapes"] == (
+        (x.shape[0] // n,) + tuple(x.shape[1:]),
+        tuple(dw.shape),
+        tuple(pw.shape),
+    )
+
+    # Uneven batch under a live mesh replicates (shard_batch's rule).
+    x7, dw7, pw7 = _random_inputs(7, 8, 8, 8, f=8, k=3)
+    with mesh:
+        jax.eval_shape(probe, x7, dw7, pw7)
+    if n > 1:
+        assert seen["shapes"][0] == tuple(x7.shape)
+
+    # Outside the context the live-mesh source disarms again.
+    assert _shard_shapes(x, dw, pw) == want_global
+
+
 def test_batch_not_divisible_by_block_still_works():
     """block_b shrinks until it tiles the batch exactly (prime batch)."""
     x, dw, pw = _random_inputs(7, 8, 8, 8, f=8, k=3, seed=9)

@@ -13,8 +13,19 @@ Usage:
     python -m tools.ckpt_fsck MODEL_DIR --repair   # quarantine + roll back
     python -m tools.ckpt_fsck MODEL_DIR --json     # machine-readable
 
-Exit status: 0 when the dir is clean (or was repaired), 1 when issues
-were found and --repair was not given, 2 on usage errors.
+Exit status (`integrity.EXIT_*`, identical with and without --repair —
+report-only mode computes the same heal it would apply, so CI's verify
+job and the chief's repair pass agree):
+    0  clean: nothing to do (also a fresh dir with no manifest)
+    1  healed: issues found, but a usable resume point survives the
+       (actual or would-be) repair
+    2  unrecoverable: the heal rolls back to iteration 0 / step 0 —
+       every trained generation was lost
+    64 usage errors (EX_USAGE; argparse's default of 2 would collide
+       with "unrecoverable")
+
+The --json report carries the same answer in its `verdict` and
+`exit_code` fields for consumers that want one parse path.
 """
 
 from __future__ import annotations
@@ -24,8 +35,14 @@ import json
 import sys
 
 
+class _Parser(argparse.ArgumentParser):
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        self.exit(64, "%s: error: %s\n" % (self.prog, message))
+
+
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(
+    parser = _Parser(
         prog="ckpt_fsck", description=__doc__.split("\n\n")[0]
     )
     parser.add_argument("model_dir", help="AdaNet model directory")
@@ -76,11 +93,19 @@ def main(argv=None) -> int:
             )
         if report.manifest_rewritten:
             print("manifest rewritten")
+        if not report.ok and not report.fresh:
+            print("verdict: %s" % report.verdict)
 
-    if report.ok or report.fresh:
-        return 0
-    return 0 if args.repair else 1
+    return report.exit_code
 
 
 if __name__ == "__main__":
+    # Direct-script invocation (`python tools/ckpt_fsck.py ...`) must
+    # find the repo package without an installed distribution; `-m`
+    # invocations already have the repo root on sys.path.
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
     sys.exit(main())

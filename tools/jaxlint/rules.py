@@ -843,6 +843,93 @@ class TracerBranchRule(Rule):
         return None
 
 
+# ---------------------------------------------------------------- JL009
+
+
+class UnboundedWaitRule(Rule):
+    """Blocking coordination/KV/synchronization waits with no bound.
+
+    A coordination-service get, a barrier, an `Event.wait()`, or a
+    zero-argument `Thread.join()`/`Popen.wait()` with no timeout turns a
+    dead peer into an indefinite hang — the failure class the robustness
+    work bounded by hand (`multihost._broadcast_tree`,
+    `coordination.wait_for_iteration`, the work-queue leases). Every
+    wait must carry a deadline so a lost peer costs one timeout, never
+    a wedged process.
+    """
+
+    rule_id = "JL009"
+    summary = "unbounded KV-store/coordination wait (no timeout/deadline)"
+
+    _TIMEOUT_KWARGS = {
+        "timeout",
+        "timeout_secs",
+        "timeout_in_ms",
+        "timeout_ms",
+        "deadline",
+        "deadline_secs",
+    }
+    #: blocking attribute call -> count of positional args that already
+    #: includes the bound (the jax coordination client takes the timeout
+    #: positionally after the key; wait/join take it first).
+    _BOUNDED_AT = {
+        "blocking_key_value_get": 2,
+        "blocking_key_value_get_bytes": 2,
+        "wait_at_barrier": 2,
+        "wait": 1,
+        "join": 1,
+    }
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                # Plain-name calls (str.join-free zone) are never the
+                # coordination surface; requiring an attribute receiver
+                # keeps `os.path.join(a, b)`-style helpers out via the
+                # positional-arg rule below.
+                continue
+            attr = node.func.attr
+            if attr not in self._BOUNDED_AT:
+                continue
+            bound_arity = self._BOUNDED_AT[attr]
+            if len(node.args) >= bound_arity:
+                continue
+            given = {kw.arg for kw in node.keywords if kw.arg}
+            if given & self._TIMEOUT_KWARGS:
+                continue
+            if attr in ("wait", "join") and self._non_blocking_receiver(
+                node
+            ):
+                continue
+            findings.append(
+                ctx.finding(
+                    node,
+                    self.rule_id,
+                    ".%s() without a timeout/deadline waits forever on "
+                    "a dead peer — bound it (a lost coordinator should "
+                    "cost one timeout, not a hang)" % attr,
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _non_blocking_receiver(node: ast.Call) -> bool:
+        """Receivers whose `.wait()`/`.join()` cannot hang on a peer.
+
+        `"sep".join(...)`/`b"".join(...)` (string building) and
+        `executor.join`-free cases with arguments are already excluded
+        by arity; this catches literal-string receivers explicitly so a
+        zero-arg `"".join()` typo never trips the rule.
+        """
+        recv = node.func.value
+        return isinstance(recv, ast.Constant) and isinstance(
+            recv.value, (str, bytes)
+        )
+
+
 ALL_RULES: List[Rule] = [
     TracerLeakRule(),
     HostSyncRule(),
@@ -852,6 +939,7 @@ ALL_RULES: List[Rule] = [
     HostModuleJnpRule(),
     UnshardedEntryRule(),
     TracerBranchRule(),
+    UnboundedWaitRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.rule_id: r for r in ALL_RULES}

@@ -164,7 +164,12 @@ def read_digest(model_dir: str, filename: str) -> Optional[str]:
     return text if re.fullmatch(r"[0-9a-f]{64}", text) else None
 
 
-def _write_digest(model_dir: str, filename: str, data: bytes) -> str:
+def write_digest(model_dir: str, filename: str, data: bytes) -> str:
+    """Writes `data`'s SHA-256 sidecar for `filename`; returns the hex.
+
+    Public: the serving publisher records the same sidecars for exported
+    generation artifacts so `verify_file` covers them too.
+    """
     digest = sha256_hex(data)
     _atomic_write_bytes(
         digest_path(model_dir, filename), digest.encode()
@@ -447,7 +452,7 @@ def save_pytree(model_dir: str, filename: str, payload: Any) -> str:
     faults.trip("checkpoint.write", path=path, data=data)
     remove_digest(model_dir, filename)
     _atomic_write_bytes(path, data)
-    return _write_digest(model_dir, filename, data)
+    return write_digest(model_dir, filename, data)
 
 
 def _read_verified(model_dir: str, filename: str) -> bytes:
@@ -512,7 +517,7 @@ def save_payload(model_dir: str, filename: str, payload: Any) -> str:
     faults.trip("checkpoint.write", path=path, data=data)
     remove_digest(model_dir, filename)
     _atomic_write_bytes(path, data)
-    return _write_digest(model_dir, filename, data)
+    return write_digest(model_dir, filename, data)
 
 
 def restore_payload(model_dir: str, filename: str) -> Any:

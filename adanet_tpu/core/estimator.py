@@ -290,6 +290,7 @@ class Estimator:
         weight_key: Optional[str] = None,
         keep_candidate_states: bool = False,
         prefetch_buffer: int = 0,
+        export_serving: bool = False,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -357,6 +358,13 @@ class Estimator:
             export_subnetwork_last_layer
         )
         self._keep_candidate_states = bool(keep_candidate_states)
+        # Serve-while-searching (ROADMAP item 1 stretch): the chief
+        # publishes every completed iteration's frozen winner as an
+        # atomic digest-sealed `serving/gen-<t>/` export, which a live
+        # `serving.ModelPool` hot-swaps under traffic behind its health
+        # gate. Publication failures never stop the search — serving
+        # simply stays on the previous generation.
+        self._export_serving = bool(export_serving)
         if prefetch_buffer < 0:
             raise ValueError("prefetch_buffer must be >= 0.")
         self._prefetch_buffer = int(prefetch_buffer)
@@ -1799,6 +1807,8 @@ class Estimator:
         if write:
             ckpt_lib.write_manifest(self._model_dir, info)
             self._remove_state_file(stale_state)
+            if self._export_serving:
+                self._publish_serving_generation(t, frozen, sample_batch)
         if self._summary is not None:
             # Scopes are per-iteration (t<N>_...); close them so open file
             # handles stay bounded across long searches.
@@ -2225,22 +2235,56 @@ class Estimator:
         if serialize_program:
             from adanet_tpu.core import export as export_lib
 
-            ensembler = self._iteration_builder._ensembler_by_name(
-                frozen.ensembler_name
-            )
-
-            def predict_fn(features):
-                features, _ = iteration_lib.split_example_weights(
-                    features, self._weight_key, require=False
-                )
-                outs = frozen.member_outputs(features, training=False)
-                ensemble = ensembler.build_ensemble(
-                    frozen.ensembler_params, outs
-                )
-                return self._predictions_with_member_outputs(ensemble)
-
             features, _ = sample_batch
             export_lib.export_serving_program(
-                export_dir, predict_fn, features
+                export_dir, self._frozen_predict_fn(frozen), features
             )
         return export_dir
+
+    def _frozen_predict_fn(self, frozen):
+        """`features -> predictions` of a frozen ensemble, with the
+        parameters closed over — the function both `export_saved_model`
+        and the per-iteration serving publisher serialize."""
+        ensembler = self._iteration_builder._ensembler_by_name(
+            frozen.ensembler_name
+        )
+
+        def predict_fn(features):
+            features, _ = iteration_lib.split_example_weights(
+                features, self._weight_key, require=False
+            )
+            outs = frozen.member_outputs(features, training=False)
+            ensemble = ensembler.build_ensemble(
+                frozen.ensembler_params, outs
+            )
+            return self._predictions_with_member_outputs(ensemble)
+
+        return predict_fn
+
+    def _publish_serving_generation(self, t, frozen, sample_batch):
+        """Chief-only, failure-isolated serving export of iteration t.
+
+        Runs after the manifest write, so a published `gen-<t>` always
+        corresponds to a durably completed generation. Any failure is
+        logged and swallowed: the searcher must never die for the
+        serving plane, and the plane itself keeps answering from the
+        previous generation when a publish is missing.
+        """
+        from adanet_tpu.serving import publisher
+
+        try:
+            features = sample_batch[0] if isinstance(
+                sample_batch, tuple
+            ) else sample_batch
+            features = jax.device_get(features)
+            publisher.publish_generation(
+                self._model_dir, t, self._frozen_predict_fn(frozen),
+                features,
+            )
+        except Exception:
+            _LOG.exception(
+                "Serving export for generation %d failed; the search "
+                "continues and serving stays on the previous "
+                "generation.",
+                t,
+            )

@@ -85,7 +85,14 @@ def export_serving_program(
         jax.tree_util.tree_leaves(sample_features)[0]
     ).shape[0]
     exported = None
+    chosen_multi = False
     last_error = None
+    # WHY a fallback happened is part of the serving contract: a
+    # single-platform artifact silently shipped to a mixed fleet is an
+    # outage waiting for the other backend, so the first failure of
+    # each degradation axis is recorded and surfaced in the signature.
+    multi_platform_fallback_reason = None
+    polymorphic_fallback_reason = None
     attempts = []
     if polymorphic_batch:
         (batch_sym,) = jax_export.symbolic_shape("batch")
@@ -95,12 +102,23 @@ def export_serving_program(
     attempts.append((concrete, bool(target_platforms)))
     if target_platforms:
         attempts.append((concrete, False))
+    chosen_batch_dim = None
     for batch_dim, multi_platform in attempts:
         try:
             exported = try_export(arg_shapes(batch_dim), multi_platform)
+            chosen_multi = multi_platform
+            chosen_batch_dim = batch_dim
             break
         except Exception as e:  # specialized models fall back
             last_error = e
+            reason = "%s: %s" % (type(e).__name__, e)
+            if multi_platform and multi_platform_fallback_reason is None:
+                multi_platform_fallback_reason = reason
+            if (
+                batch_dim is not concrete
+                and polymorphic_fallback_reason is None
+            ):
+                polymorphic_fallback_reason = reason
             _LOG.info(
                 "Export attempt (batch=%s, multi_platform=%s) failed: %s",
                 batch_dim,
@@ -112,6 +130,21 @@ def export_serving_program(
             "Could not export the serving program for any configuration; "
             "last error: %s" % last_error
         ) from last_error
+    # A recorded reason only counts as a FALLBACK when the chosen
+    # export actually lost that capability (an early mixed failure that
+    # a later attempt recovered is not a degradation).
+    if chosen_multi:
+        multi_platform_fallback_reason = None
+    if chosen_batch_dim is not concrete:
+        polymorphic_fallback_reason = None
+    if target_platforms and not chosen_multi:
+        _LOG.warning(
+            "Multi-platform export for %s fell back to single-platform "
+            "%s: %s",
+            target_platforms,
+            list(exported.platforms),
+            multi_platform_fallback_reason,
+        )
 
     os.makedirs(export_dir, exist_ok=True)
     path = os.path.join(export_dir, SERVING_FILE)
@@ -122,6 +155,12 @@ def export_serving_program(
     )
     signature = {
         "platforms": list(exported.platforms),
+        "requested_platforms": target_platforms,
+        # None when the requested capability survived; otherwise the
+        # first error that forced the degradation (the satellite fix:
+        # the fallback used to be silent).
+        "multi_platform_fallback_reason": multi_platform_fallback_reason,
+        "polymorphic_fallback_reason": polymorphic_fallback_reason,
         "inputs": jax.tree_util.tree_map(
             lambda s: {"shape": [str(d) for d in s.shape], "dtype": str(s.dtype)},
             # in_tree wraps ((args,), kwargs); expose the features arg.

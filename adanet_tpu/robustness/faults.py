@@ -25,6 +25,10 @@ Modes:
   payload) DIRECTLY at the final path, bypassing the atomic
   write-then-rename protocol, then SIGKILL: the on-disk result of a
   crash on a filesystem without atomic rename semantics.
+- `rot`: file-site only — silently flip bits of the file at `path`
+  (deterministic positions) and return WITHOUT raising: storage bit
+  rot. The process keeps running on corrupted bytes; the verify-on-read
+  digest machinery must catch it downstream.
 
 Determinism contract: a spec trips on its `after+1`-th hit and the
 `count-1` hits after that, counted per site within the process. No
@@ -54,10 +58,17 @@ FAULT_SITES = frozenset(
         "data.pull",  # core/estimator.py training-batch pulls
         "lease.renew",  # distributed/scheduler.py work-unit lease renewal
         "workunit.execute",  # distributed/scheduler.py unit execution entry
+        "serving.flip",  # serving/model_pool.py generation flip entry
+        "serving.model_load",  # serving/model_pool.py program deserialize
+        "serving.batch_execute",  # serving/batcher.py padded-batch dispatch
     }
 )
 
-_MODES = frozenset({"error", "transient", "hang", "kill", "torn"})
+_MODES = frozenset({"error", "transient", "hang", "kill", "torn", "rot"})
+
+#: Sites whose trip fires before the payload is written; `rot` there
+#: would corrupt bytes the site immediately overwrites (see `arm`).
+_WRITE_SITES = frozenset({"checkpoint.write"})
 
 ENV_VAR = "ADANET_FAULTS"
 
@@ -110,6 +121,14 @@ def arm(
     if mode not in _MODES:
         raise ValueError(
             "Unknown fault mode %r; known modes: %s" % (mode, sorted(_MODES))
+        )
+    if mode == "rot" and site in _WRITE_SITES:
+        # At a write site the trip fires BEFORE the payload lands, so
+        # the rotted bytes would be immediately overwritten by the
+        # clean write — a silently vacuous chaos run. Use `torn` there.
+        raise ValueError(
+            "rot mode is read/file-site only; %r writes its payload "
+            "after the trip (arm torn instead)" % site
         )
     spec = FaultSpec(
         site=site,
@@ -195,6 +214,26 @@ def _fire(spec: FaultSpec, path: Optional[str], data: Optional[bytes]):
     if spec.mode == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
         raise InjectedFault(message + " (SIGKILL did not take effect)")
+    if spec.mode == "rot":
+        if path is None:
+            raise InjectedFault(
+                message + " (rot mode armed at a site without a path)"
+            )
+        if data is None:
+            with open(path, "rb") as f:
+                data = f.read()
+        # Bit rot: flip the top bit of 8 deterministically-spaced bytes
+        # IN PLACE at the final path, then carry on as if nothing
+        # happened — silent corruption is the whole point of the mode.
+        rotted = bytearray(data)
+        stride = max(1, len(rotted) // 8)
+        for i in range(0, len(rotted), stride):
+            rotted[i] ^= 0x80
+        with open(path, "wb") as f:
+            f.write(bytes(rotted))
+            f.flush()
+            os.fsync(f.fileno())
+        return
     if spec.mode == "torn":
         if path is None or data is None:
             raise InjectedFault(

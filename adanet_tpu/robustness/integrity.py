@@ -39,6 +39,14 @@ _LOG = logging.getLogger("adanet_tpu")
 
 STALE_SUFFIX = ".stale"
 
+#: Serving-generation contract (mirrors `core/export.py`'s
+#: SERVING_FILE/SIGNATURE_FILE — not imported: the robustness layer must
+#: stay loadable without the export stack). A published
+#: `serving/gen-<t>/` directory must carry both files, their digest
+#: sidecars, and a checksummed `generation.json` binding them.
+GENERATION_MANIFEST = "generation.json"
+REQUIRED_SERVING_FILES = ("serving.stablehlo", "serving_signature.json")
+
 #: Exit-code contract shared by `tools/ckpt_fsck.py`, CI, and the
 #: elastic scheduler's pre-restore check (usage errors exit 64/EX_USAGE
 #: so 2 is unambiguous).
@@ -359,3 +367,77 @@ def fsck(model_dir: str, repair: bool = False) -> FsckReport:
     report.ok = not report.issues
     report.info = info
     return report
+
+
+# ------------------------------------------------- serving generation audit
+
+
+def verify_serving_generation(gen_dir: str) -> List[str]:
+    """Verifies one published `serving/gen-<t>/` directory.
+
+    Returns the list of issues; empty means the generation is eligible
+    to serve. This is the exact verify-on-load check
+    `serving.model_pool.ModelPool` runs before a flip, exposed here so
+    `ckpt_fsck --json` audits the same verdict the server would reach.
+    """
+    issues: List[str] = []
+    manifest_path = os.path.join(gen_dir, GENERATION_MANIFEST)
+    try:
+        with open(manifest_path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as exc:
+        return ["generation manifest unreadable: %s" % exc]
+    if not isinstance(obj, dict) or "digests" not in obj:
+        return ["generation manifest malformed (no digests map)"]
+    # The self-checksum is REQUIRED: the publisher always writes one,
+    # so its absence means the manifest was rewritten — accepting it
+    # would let a rewritten digests map launder rotted artifacts.
+    checksum = obj.pop("checksum", None)
+    if checksum is None:
+        return ["generation manifest missing checksum"]
+    expected = ckpt.sha256_hex(
+        json.dumps(obj, sort_keys=True).encode()
+    )
+    if checksum != expected:
+        return ["generation manifest checksum mismatch"]
+    digests = dict(obj.get("digests", {}))
+    for name in REQUIRED_SERVING_FILES:
+        if name not in digests:
+            issues.append("required serving file not recorded: %s" % name)
+    for name, digest in sorted(digests.items()):
+        verdict = ckpt.verify_file(gen_dir, name, expected=digest)
+        if verdict is not True:
+            issues.append(
+                "digest mismatch or missing file: %s" % name
+                if verdict is False
+                else "no digest verdict for: %s" % name
+            )
+    return issues
+
+
+def serving_report(model_dir: str) -> dict:
+    """Per-generation serving eligibility for a model dir.
+
+    `selected_generation` is the generation a freshly started serving
+    plane would flip to (the NEWEST eligible one — `ModelPool` applies
+    the same rule), so operators can audit a flip before it happens.
+    """
+    # Local import (not at module top): serving.publisher is a pure
+    # stdlib/lister module, but keeping robustness->serving edges lazy
+    # preserves the layering for import-time-sensitive callers.
+    from adanet_tpu.serving import publisher
+
+    generations = []
+    selected = None
+    for t, path in publisher.list_generations(model_dir):
+        issues = verify_serving_generation(path)
+        generations.append(
+            {
+                "iteration_number": t,
+                "serving_eligible": not issues,
+                "issues": issues,
+            }
+        )
+        if not issues:
+            selected = t
+    return {"generations": generations, "selected_generation": selected}

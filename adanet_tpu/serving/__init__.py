@@ -1,0 +1,67 @@
+"""Resilient serving plane: serve the frozen winner while the search runs.
+
+AdaNet's iterative structure always leaves a fully-trained t-1 ensemble
+frozen in the checkpoint generation chain while iteration t trains.
+This package turns that invariant into a serving system (ROADMAP item
+1 + its serve-while-searching stretch goal):
+
+- `publisher` — the searcher's write side: atomic, digest-sealed
+  `serving/gen-<t>/` exports (`Estimator(export_serving=True)` publishes
+  one per completed iteration).
+- `model_pool` — health-gated generation flips: verify-on-load,
+  load + smoke, live-traffic canary, automatic rollback + quarantine.
+- `batcher` — continuous padded batching over a small set of
+  AOT-compiled bucket shapes (shared `core/compile_cache.py`),
+  donated-buffer inference, canary mirroring.
+- `frontend` — bounded queue, watermark load shedding with hysteresis,
+  per-request deadline budgets, SIGTERM drain.
+
+Minimal server:
+
+    from adanet_tpu import serving
+
+    pool = serving.ModelPool(model_dir)
+    frontend = serving.ServingFrontend(serving.Batcher(pool)).start()
+    frontend.install_sigterm_handler()
+    result = frontend.submit({"x": features})   # -> ServeResult
+
+See docs/serving.md for the flip state machine, the canary gate, and
+the shed policy.
+"""
+
+from adanet_tpu.serving.batcher import Batcher, BatcherConfig
+from adanet_tpu.serving.frontend import (
+    AdmissionController,
+    ExecBudget,
+    FrontendConfig,
+    ServeResult,
+    ServingFrontend,
+)
+from adanet_tpu.serving.model_pool import (
+    GenerationRecord,
+    ModelPool,
+    NoServableGeneration,
+    PoolConfig,
+)
+from adanet_tpu.serving.publisher import (
+    generation_dir,
+    list_generations,
+    publish_generation,
+)
+
+__all__ = [
+    "AdmissionController",
+    "Batcher",
+    "BatcherConfig",
+    "ExecBudget",
+    "FrontendConfig",
+    "GenerationRecord",
+    "ModelPool",
+    "NoServableGeneration",
+    "PoolConfig",
+    "ServeResult",
+    "ServingFrontend",
+    "generation_dir",
+    "list_generations",
+    "publish_generation",
+]

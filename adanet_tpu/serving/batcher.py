@@ -1,0 +1,234 @@
+"""Continuous padded batching over a small set of AOT-compiled shapes.
+
+Requests carry independently-sized feature batches; XLA executables are
+shape-specialized. Left unchecked, live traffic would trigger one
+compile per distinct total batch size. The batcher closes the gap the
+same way `TPUEstimator`'s padded eval batching does: concatenate the
+waiting requests, pad up to the smallest **bucket** size, and execute —
+so the whole serving lifetime touches only `len(bucket_sizes)` shapes
+per generation, each compiled once and reused through the shared
+`core/compile_cache.py` (structurally identical programs across
+generations also share executables there).
+
+Execution is donated-buffer inference: the padded device batch is
+donated into the program (freeing HBM for the output buffers) on
+backends that support donation; XLA:CPU ignores donation, so it is
+skipped there to avoid a per-call warning.
+
+The batcher also runs the canary mirror for `ModelPool`: while a
+candidate generation is staged, each executed batch is replayed on the
+candidate and its health verdict (clean execution, finite outputs,
+divergence vs the incumbent) is reported back to the pool's gate.
+
+Thread contract: `execute` is NOT thread-safe; the serving front-end's
+single executor thread is the serializer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from adanet_tpu.core.compile_cache import CachedStep, CompileCache
+from adanet_tpu.robustness import faults
+from adanet_tpu.serving.model_pool import (
+    GenerationRecord,
+    ModelPool,
+    outputs_finite,
+)
+
+_LOG = logging.getLogger("adanet_tpu")
+
+
+@dataclasses.dataclass
+class BatcherConfig:
+    """`bucket_sizes` is the whole compiled-shape budget (sorted,
+    ascending); the largest bucket is the maximum total rows per
+    dispatch. `donate=None` donates the input batch wherever the
+    backend implements donation (i.e. not XLA:CPU)."""
+
+    bucket_sizes: Sequence[int] = (1, 2, 4, 8, 16, 32)
+    donate: Optional[bool] = None
+    #: Route execution through jit + the shared CompileCache (the
+    #: production path for exported programs). False executes the
+    #: generation's program as a plain callable — for host-side stub
+    #: programs in tests and diagnostics.
+    jit: bool = True
+
+
+def bucket_for(total_rows: int, bucket_sizes: Sequence[int]) -> int:
+    """Smallest bucket holding `total_rows`; raises past the largest."""
+    for size in bucket_sizes:
+        if total_rows <= size:
+            return size
+    raise ValueError(
+        "batch of %d rows exceeds the largest bucket (%d)"
+        % (total_rows, max(bucket_sizes))
+    )
+
+
+def request_rows(features: Any) -> int:
+    """Leading-dimension row count of a request's feature pytree."""
+    leaves = jax.tree_util.tree_leaves(features)
+    if not leaves:
+        raise ValueError("request has no feature leaves")
+    return int(np.asarray(leaves[0]).shape[0])
+
+
+def pad_batch(
+    features_list: Sequence[Any], bucket: int
+) -> Tuple[Any, int]:
+    """Concatenates request features and zero-pads rows to `bucket`.
+
+    Returns (padded pytree, real row count). Padding rows are zeros;
+    their outputs are computed and discarded — per-example independence
+    of inference programs makes the real rows bit-identical to an
+    unpadded evaluation at the same bucket shape.
+    """
+
+    def cat(*leaves):
+        arrays = [np.asarray(leaf) for leaf in leaves]
+        stacked = np.concatenate(arrays, axis=0)
+        total = stacked.shape[0]
+        if total > bucket:
+            raise ValueError(
+                "batch of %d rows exceeds bucket %d" % (total, bucket)
+            )
+        if total < bucket:
+            pad = np.zeros(
+                (bucket - total,) + stacked.shape[1:], stacked.dtype
+            )
+            stacked = np.concatenate([stacked, pad], axis=0)
+        return stacked
+
+    padded = jax.tree_util.tree_map(cat, *features_list)
+    total = sum(request_rows(f) for f in features_list)
+    return padded, total
+
+
+def split_rows(outputs: Any, sizes: Sequence[int]) -> List[Any]:
+    """Slices a batched output tree back into per-request trees."""
+    outputs = jax.device_get(outputs)
+    out: List[Any] = []
+    offset = 0
+    for size in sizes:
+        lo, hi = offset, offset + size
+        out.append(
+            jax.tree_util.tree_map(lambda x: x[lo:hi], outputs)
+        )
+        offset = hi
+    return out
+
+
+def max_divergence(a: Any, b: Any) -> Optional[float]:
+    """Max |a - b| over the float leaves of two output trees."""
+    worst = None
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        la, lb = np.asarray(la), np.asarray(lb)
+        if not np.issubdtype(la.dtype, np.floating):
+            continue
+        delta = float(np.max(np.abs(la - lb))) if la.size else 0.0
+        worst = delta if worst is None else max(worst, delta)
+    return worst
+
+
+class Batcher:
+    """Padded-bucket executor over the pool's incumbent generation."""
+
+    def __init__(
+        self,
+        pool: ModelPool,
+        config: Optional[BatcherConfig] = None,
+        compile_cache: Optional[CompileCache] = None,
+    ):
+        self.pool = pool
+        self.config = config or BatcherConfig()
+        if list(self.config.bucket_sizes) != sorted(
+            set(self.config.bucket_sizes)
+        ):
+            raise ValueError(
+                "bucket_sizes must be strictly ascending, got %r"
+                % (self.config.bucket_sizes,)
+            )
+        self._cache = compile_cache or CompileCache(max_entries=32)
+        self._steps: Dict[int, CachedStep] = {}
+
+    @property
+    def max_batch(self) -> int:
+        return max(self.config.bucket_sizes)
+
+    def _donate(self) -> bool:
+        if self.config.donate is not None:
+            return self.config.donate
+        # XLA:CPU ignores donation (with a warning per call); every
+        # other backend frees the padded input buffer for the outputs.
+        return jax.default_backend() != "cpu"
+
+    def _step_for(self, record: GenerationRecord):
+        if not self.config.jit:
+            return record.program
+        step = self._steps.get(record.iteration_number)
+        if step is None or getattr(step, "_program", None) is not record.program:
+            step = CachedStep(
+                record.program,
+                self._cache,
+                donate_argnums=(0,) if self._donate() else (),
+            )
+            step._program = record.program
+            self._steps[record.iteration_number] = step
+            # Stale generations never run again; keep the map bounded.
+            for t in [
+                t
+                for t in self._steps
+                if t < record.iteration_number - 2
+            ]:
+                del self._steps[t]
+        return step
+
+    def execute(
+        self, features_list: Sequence[Any]
+    ) -> Tuple[GenerationRecord, List[Any]]:
+        """Executes one formed batch; returns (generation, per-request
+        outputs). The generation is captured ONCE — a concurrent flip
+        affects only subsequent batches."""
+        record = self.pool.active_record()
+        sizes = [request_rows(f) for f in features_list]
+        bucket = bucket_for(sum(sizes), self.config.bucket_sizes)
+        padded, _ = pad_batch(features_list, bucket)
+        faults.trip("serving.batch_execute")
+        outputs = self._step_for(record)(padded)
+        split = split_rows(outputs, sizes)
+        self._mirror_canary(padded, outputs)
+        return record, split
+
+    # --------------------------------------------------------------- canary
+
+    def _mirror_canary(self, padded: Any, incumbent_outputs: Any) -> None:
+        """Replays the batch on a staged candidate and reports health."""
+        candidate = self.pool.canary_record()
+        if candidate is None:
+            return
+        try:
+            mirrored = jax.device_get(
+                self._step_for(candidate)(padded)
+            )
+            ok = outputs_finite(mirrored)
+            divergence = max_divergence(
+                jax.device_get(incumbent_outputs), mirrored
+            )
+        except Exception as exc:
+            _LOG.error(
+                "Canary execution failed for generation %d: %s: %s",
+                candidate.iteration_number,
+                type(exc).__name__,
+                exc,
+            )
+            ok, divergence = False, None
+        self.pool.report_canary(ok, divergence)

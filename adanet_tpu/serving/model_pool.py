@@ -1,0 +1,408 @@
+"""Health-gated generation flips: serve the frozen t-1 winner while t trains.
+
+The read side of the serving plane. A `ModelPool` follows the
+checkpoint generation chain (`<model_dir>/serving/gen-<t>/`, published
+by the searcher via `serving.publisher`) and hot-swaps the served
+program under live traffic. Every flip is gated:
+
+1. **verify-on-load** — `robustness.integrity.verify_serving_generation`
+   checks every artifact against its SHA-256 digest and the
+   generation manifest's self-checksum. Bit rot or a torn publish is
+   rejected before a single byte is deserialized.
+2. **load + smoke** — the StableHLO program is deserialized and executed
+   once on a zeros sample built from the exported signature; a corrupt
+   payload, a failed compile, or non-finite outputs reject the
+   generation.
+3. **canary** — while the candidate is staged, the batcher mirrors a
+   slice of live traffic onto it and reports each batch's health
+   (executed cleanly, finite outputs, bounded divergence from the
+   incumbent when `max_divergence` is set). Only after
+   `canary_requests` healthy batches does the candidate become the
+   incumbent — an atomic reference swap, so every request is answered
+   by exactly one complete generation.
+
+Any gate failure is an **automatic rollback**: the incumbent keeps
+serving, the rejected generation is quarantined (`gen-<t>.corrupt`),
+and the decision is logged. The searcher republishing iteration t after
+its own rollback-and-retrain lands in a fresh `gen-<t>` directory, so a
+quarantined flip never wedges the chain.
+
+Host-only module: the pool handles bytes, digests, and bookkeeping
+between device dispatches — execution lives in `serving.batcher`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from adanet_tpu.core import checkpoint as ckpt
+from adanet_tpu.robustness import faults, integrity
+from adanet_tpu.serving import publisher
+
+_LOG = logging.getLogger("adanet_tpu")
+
+#: A rejected generation directory is renamed with the checkpoint
+#: layer's quarantine suffix — one convention for every quarantined
+#: artifact in a model dir.
+QUARANTINE_SUFFIX = ckpt.QUARANTINE_SUFFIX
+
+PROGRAM_FILE = integrity.REQUIRED_SERVING_FILES[0]
+
+
+class NoServableGeneration(RuntimeError):
+    """No generation has passed the health gate yet."""
+
+
+@dataclasses.dataclass
+class PoolConfig:
+    """Flip-gate policy knobs.
+
+    `canary_requests` healthy mirrored batches promote a candidate;
+    more than `max_canary_failures` unhealthy ones roll it back.
+    `max_divergence` (optional) additionally bounds the max absolute
+    difference between candidate and incumbent outputs on mirrored
+    traffic — OFF by default, because consecutive AdaNet generations
+    legitimately differ (the new one has one more member); enable it
+    for replicas serving the SAME generation chain.
+    """
+
+    canary_requests: int = 8
+    max_canary_failures: int = 0
+    max_divergence: Optional[float] = None
+    quarantine: bool = True
+
+
+@dataclasses.dataclass
+class GenerationRecord:
+    """One loaded, servable generation."""
+
+    iteration_number: int
+    path: str
+    program: Callable
+    signature: Dict[str, Any]
+
+
+def _default_loader(gen_dir: str) -> Tuple[Callable, Dict[str, Any]]:
+    """Deserializes a published generation (jax.export is imported
+    lazily so pure pool logic stays importable anywhere)."""
+    from adanet_tpu.core import export as export_lib
+
+    program = export_lib.load_serving_program(gen_dir)
+    signature = export_lib.serving_signature(gen_dir)
+    return program, signature
+
+
+def _build_sample(tree, batch: int = 1):
+    """Zeros features matching the exported input signature.
+
+    Symbolic dims (the polymorphic "batch") become `batch`; concrete
+    dims are kept. Mirrors the signature's nesting so the sample feeds
+    the program directly.
+    """
+    if isinstance(tree, dict) and set(tree) == {"shape", "dtype"}:
+        shape = tuple(
+            int(d) if str(d).isdigit() else batch for d in tree["shape"]
+        )
+        return np.zeros(shape, np.dtype(tree["dtype"]))
+    if isinstance(tree, dict):
+        return {k: _build_sample(v, batch) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_build_sample(v, batch) for v in tree)
+    raise ValueError("Unrecognized signature node: %r" % (tree,))
+
+
+def outputs_finite(outputs) -> bool:
+    """True iff every float leaf of an output tree is fully finite."""
+    stack = [outputs]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        else:
+            arr = np.asarray(node)
+            if np.issubdtype(arr.dtype, np.floating) and not np.all(
+                np.isfinite(arr)
+            ):
+                return False
+    return True
+
+
+class ModelPool:
+    """Follows the generation chain; owns the incumbent and the canary.
+
+    Thread contract: `poll()` runs on one poller thread; `active_record`
+    / `canary_record` / `report_canary` are called by the batcher's
+    executor thread. All state transitions happen under one lock; the
+    flip itself is a reference swap, so a batch captured its generation
+    exactly once and is never served by a half-flipped pool.
+    """
+
+    def __init__(
+        self,
+        model_dir: str,
+        config: Optional[PoolConfig] = None,
+        loader: Optional[Callable] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._model_dir = model_dir
+        self.config = config or PoolConfig()
+        self._loader = loader or _default_loader
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active: Optional[GenerationRecord] = None
+        self._canary: Optional[GenerationRecord] = None
+        self._canary_healthy = 0
+        self._canary_failures = 0
+        # Directory identities a flip was ATTEMPTED for: a rejected
+        # generation is not retried, but a FRESH publish of the same
+        # iteration number (the searcher retrained it after its own
+        # rollback) is a new directory — publication stages in a new
+        # dir and renames, so the inode distinguishes the two even
+        # though the name matches.
+        self._attempted = set()
+        self.flips = 0
+        self.rollbacks = 0
+        self.events: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------ accessors
+
+    @property
+    def active(self) -> Optional[GenerationRecord]:
+        with self._lock:
+            return self._active
+
+    def active_record(self) -> GenerationRecord:
+        with self._lock:
+            if self._active is None:
+                raise NoServableGeneration(
+                    "no generation has passed the health gate yet"
+                )
+            return self._active
+
+    def canary_record(self) -> Optional[GenerationRecord]:
+        with self._lock:
+            return self._canary
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active_generation": (
+                    self._active.iteration_number if self._active else None
+                ),
+                "canary_generation": (
+                    self._canary.iteration_number if self._canary else None
+                ),
+                "flips": self.flips,
+                "rollbacks": self.rollbacks,
+            }
+
+    # ----------------------------------------------------------------- poll
+
+    def poll(self) -> bool:
+        """One discovery pass; returns True when pool state changed.
+
+        Skips straight to the NEWEST unattempted generation (an older
+        one that was never served is already superseded — the same rule
+        `integrity.serving_report` audits as `selected_generation`).
+        At most one flip is in flight: a staged canary must resolve
+        before the next generation is considered.
+        """
+        with self._lock:
+            if self._canary is not None:
+                return False
+            active = self._active
+        candidates = []
+        for t, path in publisher.list_generations(self._model_dir):
+            if active is not None and t <= active.iteration_number:
+                continue
+            identity = self._identity(path)
+            if identity is None or identity in self._attempted:
+                continue
+            candidates.append((t, path, identity))
+        if not candidates:
+            return False
+        t, path, identity = candidates[-1]
+        self._attempted.add(identity)
+        self._begin_flip(t, path)
+        return True
+
+    @staticmethod
+    def _identity(path: str):
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_ino, st.st_mtime_ns)
+
+    # ------------------------------------------------------------ flip gate
+
+    def _begin_flip(self, t: int, path: str) -> None:
+        program_path = os.path.join(path, PROGRAM_FILE)
+        try:
+            with open(program_path, "rb") as f:
+                program_bytes = f.read()
+        except OSError as exc:
+            self._reject(t, path, "program unreadable: %s" % exc)
+            return
+        # The chaos seam: `rot` mode flips bits of the payload on disk
+        # right here — mid-flip, after publish, before verification —
+        # and the digest check below must catch it. A RAISING mode
+        # (error/transient/hang-timeout) is a flip failure like any
+        # other: reject, so the incumbent keeps serving and the
+        # rollback is recorded — escaping the gate would leave the
+        # generation marked attempted but never quarantined, wedging
+        # the chain on the old incumbent with no event logged.
+        try:
+            faults.trip(
+                "serving.flip", path=program_path, data=program_bytes
+            )
+        except Exception as exc:
+            self._reject(
+                t,
+                path,
+                "flip interrupted: %s: %s" % (type(exc).__name__, exc),
+            )
+            return
+        issues = integrity.verify_serving_generation(path)
+        if issues:
+            self._reject(t, path, "verification failed: %s" % issues)
+            return
+        try:
+            faults.trip("serving.model_load")
+            program, signature = self._loader(path)
+        except Exception as exc:
+            self._reject(t, path, "load failed: %s: %s"
+                         % (type(exc).__name__, exc))
+            return
+        record = GenerationRecord(t, path, program, signature)
+        try:
+            sample = _build_sample(signature.get("inputs", {}))
+            outputs = program(sample)
+            if not outputs_finite(outputs):
+                raise ValueError("non-finite outputs on the smoke sample")
+        except Exception as exc:
+            self._reject(
+                t,
+                path,
+                "smoke execution failed: %s: %s"
+                % (type(exc).__name__, exc),
+            )
+            return
+        with self._lock:
+            if self._active is None:
+                # Bootstrap: no incumbent to canary against; verify +
+                # load + smoke is the whole gate.
+                self._promote_locked(record, how="bootstrap")
+                return
+            self._canary = record
+            self._canary_healthy = 0
+            self._canary_failures = 0
+        _LOG.info(
+            "SERVING CANARY: generation %d staged (window %d batches).",
+            t,
+            self.config.canary_requests,
+        )
+
+    # --------------------------------------------------------------- canary
+
+    def report_canary(
+        self, ok: bool, divergence: Optional[float] = None
+    ) -> None:
+        """One mirrored batch's verdict, reported by the batcher."""
+        reject = None
+        with self._lock:
+            record = self._canary
+            if record is None:
+                return
+            healthy = bool(ok)
+            if (
+                healthy
+                and self.config.max_divergence is not None
+                and divergence is not None
+                and divergence > self.config.max_divergence
+            ):
+                healthy = False
+            if healthy:
+                self._canary_healthy += 1
+            else:
+                self._canary_failures += 1
+            failures = self._canary_failures
+            if failures > self.config.max_canary_failures:
+                self._canary = None
+                reject = record
+            elif self._canary_healthy >= self.config.canary_requests:
+                self._promote_locked(record, how="canary")
+        if reject is not None:
+            self._reject(
+                reject.iteration_number,
+                reject.path,
+                "canary failed (%d unhealthy batches)" % failures,
+            )
+
+    # ----------------------------------------------------- promote / reject
+
+    def _promote_locked(self, record: GenerationRecord, how: str) -> None:
+        previous = self._active
+        self._active = record
+        self._canary = None
+        self.flips += 1
+        self.events.append(
+            {
+                "event": "flip",
+                "iteration_number": record.iteration_number,
+                "from": (
+                    previous.iteration_number if previous else None
+                ),
+                "how": how,
+                "at": self._clock(),
+            }
+        )
+        _LOG.warning(
+            "SERVING FLIP: generation %s -> %d (%s gate passed).",
+            previous.iteration_number if previous else None,
+            record.iteration_number,
+            how,
+        )
+
+    def _reject(self, t: int, path: str, reason: str) -> None:
+        with self._lock:
+            self.rollbacks += 1
+            incumbent = self._active
+            self.events.append(
+                {
+                    "event": "rollback",
+                    "iteration_number": t,
+                    "reason": reason,
+                    "at": self._clock(),
+                }
+            )
+        _LOG.error(
+            "SERVING ROLLBACK: generation %d rejected (%s); serving "
+            "stays on generation %s.",
+            t,
+            reason,
+            incumbent.iteration_number if incumbent else None,
+        )
+        if not self.config.quarantine:
+            return
+        target = path + QUARANTINE_SUFFIX
+        n = 0
+        while os.path.exists(target):
+            n += 1
+            target = "%s%s.%d" % (path, QUARANTINE_SUFFIX, n)
+        try:
+            os.replace(path, target)
+            _LOG.error(
+                "Quarantined rejected serving generation: %s", target
+            )
+        except OSError:
+            pass

@@ -1,0 +1,158 @@
+"""Generation publication: atomic, digest-verified serving exports.
+
+The write side of the serving plane. After the searcher freezes
+iteration t's winner, it publishes the servable artifact under the
+model dir's generation chain:
+
+    <model_dir>/serving/gen-<t>/
+        serving.stablehlo               the hermetic program (core/export.py)
+        serving.stablehlo.sha256        digest sidecar
+        serving_signature.json          shapes/dtypes/platforms (+ fallback reason)
+        serving_signature.json.sha256   digest sidecar
+        generation.json                 {iteration_number, digests, checksum}
+
+The export lands in a hidden staging directory first and is renamed
+into place, so a reader (the `ModelPool` of a live server, or
+`ckpt_fsck --json`) can never observe a half-written generation: the
+`gen-<t>` directory either exists completely or not at all — the same
+write-then-rename protocol checkpoint payloads use, one level up.
+Publication is set-once per iteration: a generation that already exists
+is never overwritten (a quarantined `gen-<t>.corrupt` does not block a
+fresh publish of the retrained iteration t).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Callable, List, Optional, Tuple
+
+from adanet_tpu.core import checkpoint as ckpt
+from adanet_tpu.robustness import integrity
+
+_LOG = logging.getLogger("adanet_tpu")
+
+#: Subdirectory of the model dir holding the generation chain.
+SERVING_SUBDIR = "serving"
+
+_GEN_RE = re.compile(r"^gen-(\d+)$")
+
+
+def serving_root(model_dir: str) -> str:
+    return os.path.join(model_dir, SERVING_SUBDIR)
+
+
+def generation_dirname(iteration_number: int) -> str:
+    return "gen-%d" % iteration_number
+
+
+def generation_dir(model_dir: str, iteration_number: int) -> str:
+    return os.path.join(
+        serving_root(model_dir), generation_dirname(iteration_number)
+    )
+
+
+def list_generations(model_dir: str) -> List[Tuple[int, str]]:
+    """(iteration_number, absolute path) of published generations, sorted.
+
+    Quarantined (`*.corrupt`) and staging directories never match the
+    `gen-<t>` pattern, so readers only ever see complete publications.
+    """
+    root = serving_root(model_dir)
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in entries:
+        match = _GEN_RE.match(name)
+        if match and os.path.isdir(os.path.join(root, name)):
+            out.append((int(match.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+def write_generation_manifest(gen_dir: str, iteration_number: int) -> None:
+    """Records `generation.json` over the artifacts already in `gen_dir`.
+
+    Digest sidecars are written for every regular file present (the
+    program and its signature), then the manifest binds them to the
+    iteration number with a self-checksum — the contract
+    `integrity.verify_serving_generation` checks before any flip.
+    """
+    digests = {}
+    for name in sorted(os.listdir(gen_dir)):
+        path = os.path.join(gen_dir, name)
+        if not os.path.isfile(path) or name.endswith(ckpt.DIGEST_SUFFIX):
+            continue
+        if name == integrity.GENERATION_MANIFEST:
+            continue
+        with open(path, "rb") as f:
+            data = f.read()
+        digests[name] = ckpt.write_digest(gen_dir, name, data)
+    missing = [
+        name
+        for name in integrity.REQUIRED_SERVING_FILES
+        if name not in digests
+    ]
+    if missing:
+        raise ValueError(
+            "Serving export incomplete; missing %s in %s"
+            % (missing, gen_dir)
+        )
+    obj = {
+        "iteration_number": int(iteration_number),
+        "digests": digests,
+    }
+    obj["checksum"] = ckpt.sha256_hex(
+        json.dumps(obj, sort_keys=True).encode()
+    )
+    ckpt.write_json(gen_dir, integrity.GENERATION_MANIFEST, obj)
+
+
+def publish_generation(
+    model_dir: str,
+    iteration_number: int,
+    predict_fn: Callable,
+    sample_features: Any,
+) -> Optional[str]:
+    """Exports and atomically publishes one serving generation.
+
+    Returns the published directory, or None when this generation was
+    already published (set-once: concurrent publishers and restarted
+    searchers converge on one artifact).
+    """
+    final = generation_dir(model_dir, iteration_number)
+    if os.path.isdir(final):
+        return None
+    root = serving_root(model_dir)
+    os.makedirs(root, exist_ok=True)
+    # Lazy: the export stack pulls in jax.export; pure readers of this
+    # module (directory listing, fsck) must not pay for it.
+    from adanet_tpu.core import export as export_lib
+
+    staging = tempfile.mkdtemp(prefix=".stage-gen-", dir=root)
+    try:
+        export_lib.export_serving_program(
+            staging, predict_fn, sample_features
+        )
+        write_generation_manifest(staging, iteration_number)
+        try:
+            os.replace(staging, final)
+        except OSError:
+            # A concurrent publisher won the rename; either artifact is
+            # the same deterministic export.
+            if os.path.isdir(final):
+                shutil.rmtree(staging, ignore_errors=True)
+                return None
+            raise
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    _LOG.info(
+        "Published serving generation %d at %s", iteration_number, final
+    )
+    return final

@@ -108,6 +108,10 @@ def test_bench_prints_one_json_line():
     # The RoundRobin executor path is benchmarked too (round-2 verdict:
     # per-submesh dispatch overhead must be measured).
     assert result["round_robin_cnn"]["examples_per_sec_per_chip"] > 0
+    # The serving plane's closed-loop latency section rides the same
+    # line (ISSUE 7): honest percentiles, zero 5xx-equivalents.
+    assert result["serving_latency"]["p99_ms"] > 0
+    assert result["serving_latency"]["error"] == 0
     # On CPU there is no axon tunnel: no timing caveat, no MFU peak.
     assert "timing_caveat" not in result
 
@@ -173,3 +177,11 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     assert result["value"] is None
     for key in ("metric", "unit", "vs_baseline"):
         assert key in result, result
+    # The serving plane benches against the CPU-exported program, so the
+    # outage record still carries real serving numbers — and zero
+    # 5xx-equivalents through the whole synthetic flood.
+    serving = result["serving_latency"]
+    assert "skipped" not in serving, serving
+    assert serving["p50_ms"] > 0 and serving["p99_ms"] >= serving["p50_ms"]
+    assert serving["qps"] > 0
+    assert serving["error"] == 0, serving

@@ -25,7 +25,14 @@ job and the chief's repair pass agree):
        with "unrecoverable")
 
 The --json report carries the same answer in its `verdict` and
-`exit_code` fields for consumers that want one parse path.
+`exit_code` fields for consumers that want one parse path, plus a
+`serving` section auditing the model dir's published serving
+generations: `serving_eligible` per generation and
+`selected_generation` — the generation a freshly started serving plane
+(`adanet_tpu.serving.ModelPool`) would flip to, so a flip can be vetted
+before it happens. Serving eligibility never affects the exit code
+(the training chain is the fsck contract; serving artifacts are
+re-publishable).
 """
 
 from __future__ import annotations
@@ -60,9 +67,15 @@ def main(argv=None) -> int:
     from adanet_tpu.robustness import integrity
 
     report = integrity.fsck(args.model_dir, repair=args.repair)
+    # Serving audit: which generation the serving plane's ModelPool
+    # would currently flip to (`serving_eligible` per published
+    # generation), so operators can vet a flip BEFORE it happens.
+    serving = integrity.serving_report(args.model_dir)
 
     if args.json:
-        print(json.dumps(report.to_json(), sort_keys=True))
+        obj = report.to_json()
+        obj["serving"] = serving
+        print(json.dumps(obj, sort_keys=True))
     else:
         if report.fresh:
             print("fresh model dir (no checkpoint manifest): nothing to do")
@@ -95,6 +108,25 @@ def main(argv=None) -> int:
             print("manifest rewritten")
         if not report.ok and not report.fresh:
             print("verdict: %s" % report.verdict)
+        for gen in serving["generations"]:
+            print(
+                "serving generation %d: %s"
+                % (
+                    gen["iteration_number"],
+                    "eligible"
+                    if gen["serving_eligible"]
+                    else "INELIGIBLE (%s)" % "; ".join(gen["issues"]),
+                )
+            )
+        if serving["generations"]:
+            print(
+                "serving plane would select: %s"
+                % (
+                    "generation %d" % serving["selected_generation"]
+                    if serving["selected_generation"] is not None
+                    else "nothing (no eligible generation)"
+                )
+            )
 
     return report.exit_code
 

@@ -685,6 +685,12 @@ class HostModuleJnpRule(Rule):
         "robustness/watchdog.py",
         "robustness/integrity.py",
         "tools/ckpt_fsck.py",
+        # The serving plane's policy layer (admission, deadlines,
+        # flips, quarantine) runs between device dispatches; only
+        # serving/batcher.py may touch device code.
+        "serving/frontend.py",
+        "serving/model_pool.py",
+        "serving/publisher.py",
     )
 
     def check(self, ctx: FileContext) -> List[Finding]:

@@ -82,6 +82,13 @@ class CheckpointInfo:
     knows each iteration's end step; `digests` maps payload filenames to
     their SHA-256 hex digests (duplicated in sidecar files so either
     survives alone).
+
+    Manifest v3 adds `store_refs`: payload filename -> the blob digest
+    published to the shared content-addressed artifact store
+    (`adanet_tpu.store`), making every checkpoint payload a store ref —
+    healable from the store and shareable across searches. v2 manifests
+    (no `version`/`store_refs` fields) read compatibly: the maps simply
+    start empty.
     """
 
     iteration_number: int = 0
@@ -91,6 +98,8 @@ class CheckpointInfo:
     generation: int = 0
     digests: Dict[str, str] = dataclasses.field(default_factory=dict)
     history: List[Dict[str, int]] = dataclasses.field(default_factory=list)
+    version: int = 3
+    store_refs: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
@@ -261,6 +270,8 @@ def _manifest_obj(info: CheckpointInfo) -> Dict[str, Any]:
         "generation": info.generation,
         "digests": info.digests,
         "history": info.history,
+        "version": info.version,
+        "store_refs": info.store_refs,
     }
     obj["checksum"] = sha256_hex(
         json.dumps(obj, sort_keys=True).encode()
@@ -290,6 +301,10 @@ def _parse_manifest(data: bytes, path: str) -> CheckpointInfo:
         generation=int(obj.get("generation", 0)),
         digests=dict(obj.get("digests", {})),
         history=list(obj.get("history", [])),
+        # v2 manifests carry neither field; they parse as an empty
+        # store-ref map under version 2 (read-compat contract).
+        version=int(obj.get("version", 2)),
+        store_refs=dict(obj.get("store_refs", {})),
     )
 
 
@@ -428,6 +443,9 @@ def write_manifest(model_dir: str, info: CheckpointInfo) -> None:
         except OSError as exc:  # keep the write going; .prev is a bonus
             _LOG.warning("Could not retain previous manifest: %s", exc)
     info.generation += 1
+    # Every write emits the current format (a restored v2 manifest is
+    # upgraded in place; `store_refs` may legitimately be empty).
+    info.version = max(int(info.version), 3)
     # Digests for files that no longer exist are dead weight (superseded
     # ckpt-* files are deleted); drop them as we go.
     info.digests = {
@@ -513,6 +531,23 @@ def save_payload(model_dir: str, filename: str, payload: Any) -> str:
     """
     os.makedirs(model_dir, exist_ok=True)
     data = serialization.msgpack_serialize(jax.device_get(payload))
+    path = os.path.join(model_dir, filename)
+    faults.trip("checkpoint.write", path=path, data=data)
+    remove_digest(model_dir, filename)
+    _atomic_write_bytes(path, data)
+    return write_digest(model_dir, filename, data)
+
+
+def write_payload_bytes(model_dir: str, filename: str, data: bytes) -> str:
+    """Lands already-serialized payload bytes with the full protocol
+    (remove sidecar -> atomic write -> sidecar); returns the digest.
+
+    Public for the warm-start replay path (`adanet_tpu.store`): a
+    payload fetched from the content-addressed store is grafted into a
+    model dir byte-identically, so digests — and therefore store blob
+    identity — are preserved across the round trip.
+    """
+    os.makedirs(model_dir, exist_ok=True)
     path = os.path.join(model_dir, filename)
     faults.trip("checkpoint.write", path=path, data=data)
     remove_digest(model_dir, filename)

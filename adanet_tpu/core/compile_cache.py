@@ -21,12 +21,29 @@ dominant cost — is skipped on a hit.
 routes compilation through a shared `CompileCache`, memoizing the
 executable per argument spec so lowering is also amortized within an
 instance.
+
+With a content-addressed `ArtifactStore` attached (`store=`), the cache
+gains a PERSISTENT tier: fresh compiles are serialized
+(`jax.experimental.serialize_executable`) and published under a ref
+keyed by (StableHLO hash, device assignment, pytree structures, env
+fingerprint), so a separate search run — or a separate process —
+sharing the store deserializes the executable instead of re-paying the
+XLA pipeline. The env fingerprint (jax, jaxlib, backend, device count;
+`store.keys.env_fingerprint`) gates deserialization exactly as
+`utils/compile_cache_dir.py` gates the jax-internal persistent cache:
+an executable from a different build or topology is unreachable, never
+fatal. Serialization support varies by backend/version, so both
+directions degrade silently to a plain compile (`store_errors` counts
+the degradations; hit/miss accounting feeds `bench.py`'s `warm_start`
+section).
 """
 
 from __future__ import annotations
 
 import collections
 import hashlib
+import logging
+import pickle
 import re
 from typing import Any, Optional, Tuple
 
@@ -35,6 +52,11 @@ import numpy as np
 
 from adanet_tpu.robustness import faults
 from adanet_tpu.robustness.retry import with_retries
+
+_LOG = logging.getLogger("adanet_tpu")
+
+#: Ref kind under which serialized executables live in the store.
+AOT_REF_KIND = "aot"
 
 
 def _leaf_spec(leaf) -> Tuple:
@@ -85,11 +107,100 @@ class CompileCache:
     executable in use.
     """
 
-    def __init__(self, max_entries: int = 128):
+    def __init__(self, max_entries: int = 128, store=None):
         self._executables = collections.OrderedDict()
         self._max_entries = int(max_entries)
+        self._store = store
         self.hits = 0
         self.misses = 0
+        #: Persistent-tier accounting: `store_hits` skipped an XLA
+        #: compile entirely (deserialized from the shared store);
+        #: `store_misses` compiled fresh (and, when serializable,
+        #: published); `store_errors` counts silent degradations
+        #: (serialize/deserialize unsupported or a corrupt/unhealable
+        #: blob) — those fall back to a plain compile.
+        self.store_hits = 0
+        self.store_misses = 0
+        self.store_errors = 0
+
+    def _store_ref_name(self, digest: str, device_fp, in_tree, out_tree):
+        from adanet_tpu.store import keys as store_keys
+
+        return store_keys.ref_name(
+            store_keys.sha256_hex(
+                "|".join(
+                    [
+                        digest,
+                        repr(device_fp),
+                        str(in_tree),
+                        str(out_tree),
+                    ]
+                ).encode()
+            ),
+            store_keys.env_fingerprint()[:16],
+        )
+
+    def _store_load(self, ref_name: str):
+        """Deserializes a previously published executable, or None."""
+        entry = self._store.get_ref(AOT_REF_KIND, ref_name)
+        if entry is None:
+            return None
+        digest = entry.get("blobs", {}).get("executable")
+        if digest is None:
+            return None
+        try:
+            blob = self._store.get(digest)
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = pickle.loads(blob)
+            return serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as exc:
+            # Unsupported backend, corrupt-and-unhealable blob, or a
+            # pickle from an incompatible build that slipped the env
+            # fingerprint: degrade to a plain compile. Executables are
+            # pure cache (no heal sources, and re-serialized bytes are
+            # not guaranteed byte-identical), so drop the set-once ref
+            # too — the fresh compile below republishes under this name
+            # with a new blob instead of leaving a permanently dangling
+            # ref the store fsck would flag forever.
+            self.store_errors += 1
+            try:
+                self._store.delete_ref(AOT_REF_KIND, ref_name)
+            except OSError:
+                pass
+            _LOG.warning(
+                "Persistent compile tier: load failed (%s: %s); "
+                "dropped the cache ref and recompiling.",
+                type(exc).__name__,
+                exc,
+            )
+            return None
+
+    def _store_save(self, ref_name: str, executable) -> None:
+        try:
+            from jax.experimental import serialize_executable
+
+            blob = pickle.dumps(serialize_executable.serialize(executable))
+            digest = self._store.put(blob)
+            self._store.put_ref(
+                AOT_REF_KIND,
+                ref_name,
+                {"executable": digest},
+                # `recreatable`: pure cache — fsck may prune the ref
+                # when its blob is unrecoverable (a fresh compile
+                # re-publishes) instead of reporting it dangling.
+                meta={"bytes": len(blob), "recreatable": True},
+            )
+        except Exception as exc:
+            self.store_errors += 1
+            _LOG.warning(
+                "Persistent compile tier: publish failed (%s: %s); "
+                "the executable stays process-local.",
+                type(exc).__name__,
+                exc,
+            )
 
     def compile(self, jitted, *args):
         """Lower `jitted` for `args`; reuse an executable when the lowered
@@ -113,23 +224,39 @@ class CompileCache:
             out_tree = jax.tree_util.tree_structure(lowered.out_info)
         except Exception:  # out_info unavailable on exotic stages
             out_tree = None
-        key = (digest, _device_fingerprint(args), in_tree, out_tree)
+        device_fp = _device_fingerprint(args)
+        key = (digest, device_fp, in_tree, out_tree)
         executable = self._executables.get(key)
         if executable is None:
-            # The compile may read a persistent on-disk XLA cache (see
-            # utils/compile_cache_dir.py): a transient I/O error there —
-            # or at the `compile_cache.read` fault site chaos runs arm —
-            # is retried with bounded deterministic backoff instead of
-            # killing a multi-hour search over one EIO.
-            def compile_once():
-                faults.trip("compile_cache.read")
-                return lowered.compile()
+            ref_name = None
+            if self._store is not None:
+                # Persistent tier: another run sharing the store may
+                # have already paid this compile.
+                ref_name = self._store_ref_name(
+                    digest, device_fp, in_tree, out_tree
+                )
+                executable = self._store_load(ref_name)
+            if executable is not None:
+                self.store_hits += 1
+            else:
+                # The compile may read a persistent on-disk XLA cache
+                # (see utils/compile_cache_dir.py): a transient I/O
+                # error there — or at the `compile_cache.read` fault
+                # site chaos runs arm — is retried with bounded
+                # deterministic backoff instead of killing a multi-hour
+                # search over one EIO.
+                def compile_once():
+                    faults.trip("compile_cache.read")
+                    return lowered.compile()
 
-            executable = with_retries(
-                compile_once, label="compile-cache read"
-            )
+                executable = with_retries(
+                    compile_once, label="compile-cache read"
+                )
+                self.misses += 1
+                if ref_name is not None:
+                    self.store_misses += 1
+                    self._store_save(ref_name, executable)
             self._executables[key] = executable
-            self.misses += 1
             while len(self._executables) > self._max_entries:
                 self._executables.popitem(last=False)
         else:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import inspect
 import itertools
+import json
 import logging
 import math
 import os
@@ -229,6 +230,18 @@ class Estimator:
       force_grow: at t>0 never re-select the carried-over previous ensemble
         (reference: estimator.py:1447-1451, 1504-1511).
       replay_config: `adanet_tpu.replay.Config` to replay recorded choices.
+        With an `artifact_store` attached, iterations whose recorded
+        winner is already published in the store are grafted straight
+        from it — zero XLA compiles and zero retraining of unchanged
+        members (see docs/artifact_store.md).
+      artifact_store: an `adanet_tpu.store.ArtifactStore` (or its root
+        path) shared across searches and serving pools. When set: the
+        compile cache gains a persistent store-backed tier, completed
+        iterations' frozen payloads and architectures are published as
+        content-addressed refs (manifest v3 `store_refs`), serving
+        generations publish their ref closure, the search holds a TTL
+        lease on everything it references (so concurrent GC can never
+        reclaim it), and `replay.json` warm starts become zero-cost.
       max_iterations: stop after this many iterations (None = until
         max_steps).
       model_dir: durable state directory; a temp dir when None.
@@ -291,6 +304,7 @@ class Estimator:
         keep_candidate_states: bool = False,
         prefetch_buffer: int = 0,
         export_serving: bool = False,
+        artifact_store=None,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -383,11 +397,29 @@ class Estimator:
         self._elastic_batches = None
         self._speculation = None
 
+        # Shared content-addressed artifact store (ROADMAP item 5):
+        # compiled executables and frozen payloads published here are
+        # reused by every search/serving process pointing at the same
+        # root. Accepts a constructed store or a root path.
+        self._artifact_store = None
+        if artifact_store is not None:
+            from adanet_tpu.store import ArtifactStore
+
+            self._artifact_store = (
+                artifact_store
+                if isinstance(artifact_store, ArtifactStore)
+                else ArtifactStore(str(artifact_store))
+            )
+        self._store_lease = None
+        self._warned_replay_serving = False
+
         # One executable cache for the whole search: iteration t+1's
         # structurally-identical programs (same-architecture candidates
         # under RoundRobin, rebuilt iterations after restart) skip XLA
-        # compilation (SURVEY §7 hard part (a)).
-        self._compile_cache = CompileCache()
+        # compilation (SURVEY §7 hard part (a)). With an artifact store
+        # attached it grows the persistent tier: structurally-identical
+        # programs from SEPARATE runs skip XLA too.
+        self._compile_cache = CompileCache(store=self._artifact_store)
         self._iteration_builder = IterationBuilder(
             head=head,
             ensemblers=self._ensemblers,
@@ -524,6 +556,20 @@ class Estimator:
                 heal.quarantined or heal.issues,
             )
         info = heal.info or ckpt_lib.CheckpointInfo()
+        if self._artifact_store is not None and coordination.is_chief():
+            # Pin everything this search will reference against
+            # concurrent GC (TTL-leased: a SIGKILLed search costs one
+            # TTL, then its pins expire), and re-publish any completed
+            # iteration whose store ref is missing — the crash window
+            # between the artifact write and the ref write.
+            from adanet_tpu.store import leases as store_leases
+
+            self._store_lease = store_leases.acquire(
+                self._artifact_store,
+                owner="search-%d" % os.getpid(),
+                ttl_secs=self._store_lease_ttl_secs(),
+            )
+            self._store_reconcile(info)
         # Degraded mode: set once a multi-host peer is declared lost;
         # collective agreement (stop checks, bookkeeping) then falls back
         # to process-local behavior and the search stops at the next
@@ -580,7 +626,19 @@ class Estimator:
             self._train_loop(
                 input_fn, max_steps, info, data_iter, cached_previous
             )
+            if coordination.is_chief():
+                # Search end: record the replay config (winner indices +
+                # architecture hashes per completed iteration) so this
+                # run is warm-startable without hand-constructing one.
+                self._write_replay_record()
         finally:
+            if self._store_lease is not None:
+                from adanet_tpu.store import leases as store_leases
+
+                store_leases.release(
+                    self._artifact_store, self._store_lease
+                )
+                self._store_lease = None
             if heartbeat is not None:
                 heartbeat.stop()
             if handler_installed:
@@ -686,6 +744,14 @@ class Estimator:
                 break
             if max_steps is not None and info.global_step >= max_steps:
                 break
+
+            if self._try_store_replay(t, info):
+                # Warm start: the recorded winner of iteration t was
+                # grafted straight from the shared store — no batches
+                # pulled, no programs built, no training. The next
+                # trained iteration (if any) rebuilds from disk.
+                cached_previous = None
+                continue
 
             batch, data_iter = self._next_batch(input_fn, data_iter)
             sample_batch = batch
@@ -1805,6 +1871,10 @@ class Estimator:
             }
         )
         if write:
+            if self._artifact_store is not None:
+                # Before the manifest write, so the v3 `store_refs`
+                # entry rides this generation's manifest.
+                self._store_publish_iteration(t, info)
             ckpt_lib.write_manifest(self._model_dir, info)
             self._remove_state_file(stale_state)
             if self._export_serving:
@@ -2204,6 +2274,275 @@ class Estimator:
             features = batch[0] if isinstance(batch, tuple) else batch
             yield jax.device_get(predict_fn(params, features))
 
+    # --------------------------------------------------- artifact store
+
+    def _store_lease_ttl_secs(self) -> float:
+        """`ADANET_STORE_LEASE_TTL_SECS` (default 3600): how long this
+        search's store pins outlive a crash before GC may reclaim."""
+        raw = os.environ.get("ADANET_STORE_LEASE_TTL_SECS", "").strip()
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                _LOG.warning(
+                    "Ignoring non-numeric ADANET_STORE_LEASE_TTL_SECS=%r.",
+                    raw,
+                )
+        return 3600.0
+
+    def _store_spec_fingerprint(self) -> str:
+        """What makes numerically different frozen payloads under the
+        SAME architecture: the base seed and the per-iteration step
+        budget. Two searches agreeing on both (and on the architecture
+        hash) train bit-identical members — the sharing contract."""
+        from adanet_tpu.store import keys as store_keys
+
+        return store_keys.spec_fingerprint(
+            {
+                "random_seed": self._random_seed,
+                "max_iteration_steps": self._max_iteration_steps,
+            }
+        )[:16]
+
+    def _frozen_ref_name(self, arch_hash: str, t: int) -> str:
+        """`frozen/<arch_hash>-t<iter>-<spec>`.
+
+        The iteration number is part of the key: a re-selected
+        (non-grown) winner has the SAME structural hash as its previous
+        iteration but different numeric state (its ensemble layer
+        trained further), so structure alone would collide the two.
+        """
+        from adanet_tpu.store import keys as store_keys
+
+        return store_keys.ref_name(
+            arch_hash, "t%d" % int(t), self._store_spec_fingerprint()
+        )
+
+    def _store_lease_pin(self, digests) -> None:
+        """Adds digests to this search's lease and extends its TTL."""
+        if self._store_lease is None:
+            return
+        from adanet_tpu.store import leases as store_leases
+
+        try:
+            store_leases.renew(
+                self._artifact_store,
+                self._store_lease,
+                self._store_lease_ttl_secs(),
+                add_digests=digests,
+            )
+        except OSError as exc:
+            _LOG.warning("Store lease renewal failed: %s", exc)
+
+    def _store_publish_iteration(self, t: int, info) -> None:
+        """Publishes iteration t's frozen winner to the shared store.
+
+        One ref (`frozen/<arch_hash>-<spec>`) binding the architecture
+        JSON and the frozen payload blobs, with the model dir's own
+        copies recorded as heal sources. Failure-isolated: the store is
+        an accelerator, so a store outage degrades to "no sharing",
+        never a dead search (armed `store.put` error faults exercise
+        exactly this).
+        """
+        frozen_name = ckpt_lib.frozen_filename(t)
+        arch_path = os.path.join(
+            self._model_dir, ckpt_lib.architecture_filename(t)
+        )
+        frozen_path = os.path.join(self._model_dir, frozen_name)
+        try:
+            from adanet_tpu.store import keys as store_keys
+
+            with open(arch_path, "rb") as f:
+                arch_bytes = f.read()
+            with open(frozen_path, "rb") as f:
+                frozen_bytes = f.read()
+            arch_hash = store_keys.architecture_hash(
+                json.loads(arch_bytes)
+            )
+            store = self._artifact_store
+            arch_digest = store.put(arch_bytes)
+            frozen_digest = store.put(frozen_bytes)
+            ref = store.put_ref(
+                "frozen",
+                self._frozen_ref_name(arch_hash, t),
+                {
+                    "architecture.json": arch_digest,
+                    "frozen.msgpack": frozen_digest,
+                },
+                meta={
+                    "iteration_number": int(t),
+                    "global_step": int(info.global_step),
+                },
+                sources=[arch_path, frozen_path],
+            )
+            info.store_refs[frozen_name] = ref["blobs"].get(
+                "frozen.msgpack", frozen_digest
+            )
+            self._store_lease_pin(
+                sorted(set(ref["blobs"].values()))
+            )
+        except Exception:
+            _LOG.exception(
+                "Store publication for iteration %d failed; the search "
+                "continues without sharing this artifact.",
+                t,
+            )
+
+    def _store_reconcile(self, info) -> None:
+        """Chief-only: re-publishes completed iterations whose store
+        ref is missing (a crash between the artifact and ref writes, or
+        a store attached to a pre-store model dir)."""
+        from adanet_tpu.store import keys as store_keys
+
+        for t in range(info.iteration_number):
+            arch_path = os.path.join(
+                self._model_dir, ckpt_lib.architecture_filename(t)
+            )
+            frozen_path = os.path.join(
+                self._model_dir, ckpt_lib.frozen_filename(t)
+            )
+            if not (
+                os.path.exists(arch_path)
+                and os.path.exists(frozen_path)
+            ):
+                continue  # fsck owns broken chains
+            try:
+                arch_hash = store_keys.architecture_hash_from_file(
+                    arch_path
+                )
+            except (OSError, ValueError):
+                continue
+            if (
+                self._artifact_store.get_ref(
+                    "frozen", self._frozen_ref_name(arch_hash, t)
+                )
+                is None
+            ):
+                self._store_publish_iteration(t, info)
+        # Serving generations published on disk but missing their store
+        # closure (SIGKILL mid-closure-publication) re-publish too —
+        # the puts double as heal-on-put for any torn blob the crash
+        # left behind.
+        if self._export_serving:
+            from adanet_tpu.serving import publisher
+
+            for t, _path in publisher.list_generations(self._model_dir):
+                publisher.publish_ref_closure(
+                    self._artifact_store, self._model_dir, t
+                )
+
+    def _try_store_replay(self, t: int, info) -> bool:
+        """Grafts iteration t straight from the store when the replay
+        config records its winner there: zero batches, zero programs,
+        zero XLA compiles, zero retraining. Returns False (fall back to
+        a normal trained iteration) whenever anything is missing."""
+        if (
+            self._replay_config is None
+            or self._artifact_store is None
+            or not coordination.is_chief()
+            or jax.process_count() > 1
+        ):
+            return False
+        get_hash = getattr(
+            self._replay_config, "get_architecture_hash", None
+        )
+        arch_hash = get_hash(t) if get_hash is not None else None
+        if arch_hash is None:
+            return False
+        store = self._artifact_store
+        ref = store.get_ref(
+            "frozen", self._frozen_ref_name(arch_hash, t)
+        )
+        if ref is None:
+            return False
+        blobs = ref.get("blobs", {})
+        if not {"architecture.json", "frozen.msgpack"} <= set(blobs):
+            return False
+        from adanet_tpu.store.blobstore import StoreError
+
+        try:
+            arch_bytes = store.get(blobs["architecture.json"])
+            frozen_bytes = store.get(blobs["frozen.msgpack"])
+        except StoreError as exc:
+            _LOG.warning(
+                "Warm start for iteration %d unavailable (%s); "
+                "training it instead.",
+                t,
+                exc,
+            )
+            return False
+        arch_obj = json.loads(arch_bytes)
+        # Land the artifacts byte-identically to a trained iteration's,
+        # then advance the manifest exactly as _complete_iteration does.
+        frozen_name = ckpt_lib.frozen_filename(t)
+        ckpt_lib.write_json(
+            self._model_dir, ckpt_lib.architecture_filename(t), arch_obj
+        )
+        info.digests[frozen_name] = ckpt_lib.write_payload_bytes(
+            self._model_dir, frozen_name, frozen_bytes
+        )
+        info.store_refs[frozen_name] = blobs["frozen.msgpack"]
+        stale_state = info.iteration_state_file
+        info.iteration_number = t + 1
+        info.iteration_state_file = None
+        info.replay_indices = list(arch_obj.get("replay_indices", []))
+        info.global_step = int(
+            arch_obj.get("global_step", info.global_step)
+        )
+        info.history.append(
+            {
+                "iteration_number": t,
+                "global_step": int(info.global_step),
+                "generation": info.generation + 1,
+            }
+        )
+        ckpt_lib.write_manifest(self._model_dir, info)
+        self._remove_state_file(stale_state)
+        self._store_lease_pin(sorted(set(blobs.values())))
+        self._iteration_cache = None
+        if self._export_serving and not self._warned_replay_serving:
+            # The graft path has no trained state (and no sample batch)
+            # to export from, so replayed iterations publish no
+            # `serving/gen-<t>/`. Say so once instead of leaving an
+            # silently empty serving root; export_saved_model (or one
+            # trained iteration) fills the gap.
+            self._warned_replay_serving = True
+            _LOG.warning(
+                "Warm-started iterations do not publish serving "
+                "generations (no trained state to export); run "
+                "export_saved_model after the replay, or continue the "
+                "search past the replayed prefix, to produce a "
+                "servable artifact."
+            )
+        _LOG.info(
+            "Iteration %d warm-started from the artifact store "
+            "(architecture %s): zero compiles, zero retraining.",
+            t,
+            arch_hash[:12],
+        )
+        return True
+
+    def _write_replay_record(self) -> None:
+        """Persists `replay.json` at search end (freshly derived, so a
+        resumed search never re-emits a stale record)."""
+        try:
+            from adanet_tpu import replay as replay_lib
+
+            config = replay_lib.Config.from_model_dir(
+                self._model_dir, prefer_recorded=False
+            )
+            if config.num_iterations:
+                config.save(
+                    os.path.join(
+                        self._model_dir, replay_lib.REPLAY_FILENAME
+                    )
+                )
+        except Exception:
+            _LOG.exception(
+                "Could not write the replay record; the search result "
+                "itself is unaffected."
+            )
+
     # ---------------------------------------------------------------- export
 
     def export_saved_model(
@@ -2279,7 +2618,7 @@ class Estimator:
             features = jax.device_get(features)
             publisher.publish_generation(
                 self._model_dir, t, self._frozen_predict_fn(frozen),
-                features,
+                features, store=self._artifact_store,
             )
         except Exception:
             _LOG.exception(

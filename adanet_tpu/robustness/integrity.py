@@ -441,3 +441,27 @@ def serving_report(model_dir: str) -> dict:
         if not issues:
             selected = t
     return {"generations": generations, "selected_generation": selected}
+
+
+# --------------------------------------------------- artifact store audit
+
+
+def store_report(
+    store_root: str,
+    repair: bool = False,
+    gc_dry_run: bool = False,
+) -> dict:
+    """The `store` section of `ckpt_fsck --json`.
+
+    Thin wiring over `adanet_tpu.store.fsck_store` (lazy import — the
+    checkpoint-chain fsck must stay usable without the store package):
+    blob census (count/bytes), corrupt and quarantined blobs, dangling
+    refs, lease census, and — under `--gc --dry-run` — the would-GC
+    set. `repair` quarantines corrupt blobs and heals them from any
+    duplicate referencer, the same path a live `store.get` takes.
+    """
+    from adanet_tpu.store import ArtifactStore, fsck_store
+
+    return fsck_store(
+        ArtifactStore(store_root), repair=repair, gc_dry_run=gc_dry_run
+    )

@@ -34,6 +34,7 @@ between device dispatches — execution lives in `serving.batcher`.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import threading
@@ -152,11 +153,20 @@ class ModelPool:
         config: Optional[PoolConfig] = None,
         loader: Optional[Callable] = None,
         clock: Callable[[], float] = time.monotonic,
+        store=None,
+        store_lease_ttl_secs: float = 3600.0,
     ):
         self._model_dir = model_dir
         self.config = config or PoolConfig()
         self._loader = loader or _default_loader
         self._clock = clock
+        # Shared artifact store (`adanet_tpu.store`): when attached,
+        # every promoted generation's ref closure is pinned under a TTL
+        # lease, so a GC pass on the shared store can never reclaim
+        # blobs the live pool may need for healing or reload.
+        self._store = store
+        self._store_lease = None
+        self._store_lease_ttl = float(store_lease_ttl_secs)
         self._lock = threading.Lock()
         self._active: Optional[GenerationRecord] = None
         self._canary: Optional[GenerationRecord] = None
@@ -297,15 +307,20 @@ class ModelPool:
                 % (type(exc).__name__, exc),
             )
             return
+        promoted = None
         with self._lock:
             if self._active is None:
                 # Bootstrap: no incumbent to canary against; verify +
                 # load + smoke is the whole gate.
                 self._promote_locked(record, how="bootstrap")
-                return
-            self._canary = record
-            self._canary_healthy = 0
-            self._canary_failures = 0
+                promoted = record
+            else:
+                self._canary = record
+                self._canary_healthy = 0
+                self._canary_failures = 0
+        if promoted is not None:
+            self._pin_store_closure(promoted)
+            return
         _LOG.info(
             "SERVING CANARY: generation %d staged (window %d batches).",
             t,
@@ -318,7 +333,7 @@ class ModelPool:
         self, ok: bool, divergence: Optional[float] = None
     ) -> None:
         """One mirrored batch's verdict, reported by the batcher."""
-        reject = None
+        reject = promoted = None
         with self._lock:
             record = self._canary
             if record is None:
@@ -341,6 +356,9 @@ class ModelPool:
                 reject = record
             elif self._canary_healthy >= self.config.canary_requests:
                 self._promote_locked(record, how="canary")
+                promoted = record
+        if promoted is not None:
+            self._pin_store_closure(promoted)
         if reject is not None:
             self._reject(
                 reject.iteration_number,
@@ -372,6 +390,72 @@ class ModelPool:
             record.iteration_number,
             how,
         )
+
+    def _pin_store_closure(self, record: GenerationRecord) -> None:
+        """Leases the promoted generation's blob closure against GC.
+
+        Called by the promote sites AFTER the pool lock is released:
+        the pin does file I/O against a possibly-remote store, and a
+        stalled store must never wedge `active_record()` callers on the
+        lock. The closure digests come from the published store ref
+        when present, else from the generation manifest (identical
+        values: blobs are the same bytes the manifest digests cover).
+        Failure is isolated — serving never depends on the store being
+        up.
+        """
+        if self._store is None:
+            return
+        try:
+            from adanet_tpu.store import leases as store_leases
+
+            digests = set()
+            ref = self._store.get_ref(
+                "serving",
+                publisher.serving_ref_name(
+                    self._model_dir, record.iteration_number
+                ),
+            )
+            if ref is not None:
+                digests.update(ref.get("blobs", {}).values())
+            else:
+                manifest = os.path.join(
+                    record.path, integrity.GENERATION_MANIFEST
+                )
+                with open(manifest) as f:
+                    digests.update(
+                        json.load(f).get("digests", {}).values()
+                    )
+            if not digests:
+                return
+            if self._store_lease is None:
+                self._store_lease = store_leases.acquire(
+                    self._store,
+                    owner="serving-%d" % os.getpid(),
+                    ttl_secs=self._store_lease_ttl,
+                    digests=sorted(digests),
+                )
+            else:
+                store_leases.renew(
+                    self._store,
+                    self._store_lease,
+                    self._store_lease_ttl,
+                    add_digests=digests,
+                )
+        except Exception:
+            _LOG.exception(
+                "Store lease pin for generation %d failed; serving "
+                "continues unpinned.",
+                record.iteration_number,
+            )
+
+    def release_store_lease(self) -> None:
+        """Drops this pool's GC pin (shutdown path)."""
+        if self._store is None or self._store_lease is None:
+            return
+        from adanet_tpu.store import leases as store_leases
+
+        store_leases.release(self._store, self._store_lease)
+        self._store_lease = None
 
     def _reject(self, t: int, path: str, reason: str) -> None:
         with self._lock:

@@ -118,15 +118,27 @@ def publish_generation(
     iteration_number: int,
     predict_fn: Callable,
     sample_features: Any,
+    store=None,
 ) -> Optional[str]:
     """Exports and atomically publishes one serving generation.
 
     Returns the published directory, or None when this generation was
     already published (set-once: concurrent publishers and restarted
     searchers converge on one artifact).
+
+    With an `ArtifactStore` attached, the generation is ALSO published
+    as a ref closure (`serving/<dir-id>-gen<t>`): every artifact blob
+    lands in the content-addressed store with the gen dir recorded as a
+    heal source, so serving pools can lease the closure against GC and
+    a rotted file is recoverable from the store (and vice versa). The
+    closure publication is idempotent and re-attempted when the gen dir
+    already exists but the ref is missing — the crash window of a
+    publisher SIGKILLed mid-publish.
     """
     final = generation_dir(model_dir, iteration_number)
     if os.path.isdir(final):
+        if store is not None:
+            publish_ref_closure(store, model_dir, iteration_number)
         return None
     root = serving_root(model_dir)
     os.makedirs(root, exist_ok=True)
@@ -152,7 +164,66 @@ def publish_generation(
     except BaseException:
         shutil.rmtree(staging, ignore_errors=True)
         raise
+    if store is not None:
+        publish_ref_closure(store, model_dir, iteration_number)
     _LOG.info(
         "Published serving generation %d at %s", iteration_number, final
     )
     return final
+
+
+def serving_ref_name(model_dir: str, iteration_number: int) -> str:
+    """Store ref name of one model dir's generation closure."""
+    from adanet_tpu.store import keys as store_keys
+
+    dir_id = store_keys.sha256_hex(
+        os.path.abspath(model_dir).encode()
+    )[:16]
+    return store_keys.ref_name(dir_id, "gen%d" % int(iteration_number))
+
+
+def publish_ref_closure(
+    store, model_dir: str, iteration_number: int
+) -> Optional[dict]:
+    """Publishes a generation's artifacts as a store ref closure.
+
+    Failure-isolated like the export itself: a store outage degrades to
+    "this generation is not shared/healable", never a dead searcher.
+    Returns the ref document, or None when publication failed or the
+    generation dir is incomplete.
+    """
+    gen_dir = generation_dir(model_dir, iteration_number)
+    name = serving_ref_name(model_dir, iteration_number)
+    try:
+        if store.get_ref("serving", name) is not None:
+            return None  # set-once: the closure already landed
+        blobs = {}
+        sources = []
+        for entry in sorted(os.listdir(gen_dir)):
+            path = os.path.join(gen_dir, entry)
+            if not os.path.isfile(path) or entry.endswith(
+                ckpt.DIGEST_SUFFIX
+            ):
+                continue
+            with open(path, "rb") as f:
+                blobs[entry] = store.put(f.read())
+            sources.append(path)
+        if not blobs:
+            return None
+        return store.put_ref(
+            "serving",
+            name,
+            blobs,
+            meta={
+                "model_dir": os.path.abspath(model_dir),
+                "iteration_number": int(iteration_number),
+            },
+            sources=sources,
+        )
+    except Exception:
+        _LOG.exception(
+            "Store closure publication for serving generation %d "
+            "failed; the on-disk generation is unaffected.",
+            iteration_number,
+        )
+        return None

@@ -1,0 +1,123 @@
+"""Key derivation for the content-addressed artifact store.
+
+Blobs are keyed by their own SHA-256 — nothing to derive. Refs need
+stable, collision-resistant names built from three ingredients the
+consumers share (see docs/artifact_store.md):
+
+- the **architecture hash**: the structural identity of an ensemble —
+  its member (iteration, builder) pairs, ensembler, and iteration
+  number, with volatile bookkeeping (global step, replay indices)
+  excluded, so two searches that grew the same ensemble agree on the
+  name regardless of how they selected it;
+- a **spec fingerprint**: whatever run configuration makes numerically
+  different artifacts under the same structure (seed, step budget,
+  shapes/dtypes of the programs) — the caller declares it as a plain
+  JSON-able dict;
+- the **env fingerprint**: (jax, jaxlib, backend, device count) — the
+  same signature `utils/compile_cache_dir.py` keys the persistent XLA
+  cache by, because a serialized executable deserialized under a
+  different build or topology can crash the process outright. Host-side
+  payloads (checkpoint pytrees) deliberately exclude it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Optional
+
+_HEX64 = frozenset("0123456789abcdef")
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def is_digest(text: str) -> bool:
+    """True for a lowercase 64-char SHA-256 hex string."""
+    return len(text) == 64 and set(text) <= _HEX64
+
+
+def canonical_json(obj: Any) -> bytes:
+    """The byte form every fingerprint hashes (sorted keys, no spaces)."""
+    return json.dumps(
+        obj, sort_keys=True, separators=(",", ":")
+    ).encode()
+
+
+def spec_fingerprint(spec: Dict[str, Any]) -> str:
+    """Hash of a caller-declared configuration dict (JSON-able values)."""
+    return sha256_hex(canonical_json(spec))
+
+
+_env_fp_cache: Optional[str] = None
+
+
+def env_fingerprint() -> str:
+    """Hash of (jax, jaxlib, backend, device count) for THIS process.
+
+    Initializes the jax backend on first call (same caveat as
+    `utils/compile_cache_dir.versioned_cache_dir`, which this reuses:
+    the two caches must agree on what "the same environment" means).
+    """
+    global _env_fp_cache
+    if _env_fp_cache is None:
+        from adanet_tpu.utils.compile_cache_dir import versioned_cache_dir
+        import os
+
+        tag = os.path.basename(versioned_cache_dir("."))
+        _env_fp_cache = sha256_hex(tag.encode())
+    return _env_fp_cache
+
+
+def architecture_hash(arch_obj: Dict[str, Any]) -> str:
+    """Structural hash of a serialized `core.architecture.Architecture`.
+
+    Keeps: iteration number, ensembler, candidate name, and the member
+    (iteration, builder) pairs. Drops: `global_step` (a consequence of
+    the step budget, not identity) and `replay_indices` (how the winner
+    was picked, not what it is) — so an Evaluator-driven search and a
+    replayed one hash the same ensemble identically.
+    """
+    members = [
+        [int(entry["iteration_number"]), str(entry["builder_name"])]
+        for entry in arch_obj.get("subnetworks", [])
+    ]
+    return sha256_hex(
+        canonical_json(
+            {
+                "ensemble_candidate_name": arch_obj.get(
+                    "ensemble_candidate_name"
+                ),
+                "ensembler_name": arch_obj.get("ensembler_name"),
+                "iteration_number": int(
+                    arch_obj.get("iteration_number", 0)
+                ),
+                "subnetworks": members,
+            }
+        )
+    )
+
+
+def architecture_hash_from_file(path: str) -> str:
+    """`architecture_hash` of an `architecture-<t>.json` on disk."""
+    with open(path) as f:
+        return architecture_hash(json.load(f))
+
+
+def ref_name(*parts: str) -> str:
+    """Joins key ingredients into one filesystem-safe ref name.
+
+    Parts are joined with `-`; each must already be filesystem-safe
+    (hex digests from the helpers above, or short [A-Za-z0-9_]+ tags).
+    """
+    for part in parts:
+        if (
+            not part
+            or not part.strip(".")  # "." / ".." resolve upward
+            or not all(c.isalnum() or c in "_." for c in part)
+        ):
+            raise ValueError(
+                "ref name part %r is not filesystem-safe" % (part,)
+            )
+    return "-".join(parts)

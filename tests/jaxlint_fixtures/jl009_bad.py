@@ -26,3 +26,9 @@ def wait_on_peer(event: threading.Event):
 def reap(worker: threading.Thread, proc):
     worker.join()  # expect: JL009
     proc.wait()  # expect: JL009
+
+
+def wait_on_publisher(store):
+    # The artifact store's ref wait is a claim/lease coordination
+    # surface like any other: unbounded means a dead publisher hangs us.
+    return store.wait_for_ref("frozen", "abc-def")  # expect: JL009

@@ -29,6 +29,14 @@ def reap_bounded(worker: threading.Thread, proc):
     proc.wait(timeout=60)
 
 
+def wait_on_publisher_bounded(store):
+    return store.wait_for_ref("frozen", "abc-def", 30.0)
+
+
+def wait_on_publisher_kwarg(store):
+    return store.wait_for_ref("frozen", "abc-def", timeout_secs=30.0)
+
+
 def string_building(parts):
     # str/bytes receivers and arg-carrying joins never block on a peer.
     joined = ", ".join(parts)

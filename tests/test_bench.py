@@ -112,6 +112,14 @@ def test_bench_prints_one_json_line():
     # line (ISSUE 7): honest percentiles, zero 5xx-equivalents.
     assert result["serving_latency"]["p99_ms"] > 0
     assert result["serving_latency"]["error"] == 0
+    # Warm-start accounting across runs sharing one artifact store
+    # (ISSUE 10): the replayed run compiles and trains nothing.
+    warm = result["warm_start"]
+    assert "skipped" not in warm, warm
+    assert warm["zero_compile_warm_start"] is True, warm
+    assert warm["cold"]["xla_compiles"] > 0
+    assert warm["shared_store_fresh"]["store_hits"] > 0
+    assert warm["store"]["clean"] is True
     # On CPU there is no axon tunnel: no timing caveat, no MFU peak.
     assert "timing_caveat" not in result
 
@@ -185,3 +193,8 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     assert serving["p50_ms"] > 0 and serving["p99_ms"] >= serving["p50_ms"]
     assert serving["qps"] > 0
     assert serving["error"] == 0, serving
+    # The warm-start section is host+store machinery: real numbers on
+    # the outage path too.
+    warm = result["warm_start"]
+    assert "skipped" not in warm, warm
+    assert warm["zero_compile_warm_start"] is True, warm

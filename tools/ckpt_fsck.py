@@ -33,12 +33,23 @@ generations: `serving_eligible` per generation and
 before it happens. Serving eligibility never affects the exit code
 (the training chain is the fsck contract; serving artifacts are
 re-publishable).
+
+With `--store PATH` (auto-detected at `<model_dir>/store` when
+present), the report also grows a `store` section over the shared
+content-addressed artifact store (`adanet_tpu.store`): blob count and
+bytes, corrupt/quarantined blobs, dangling refs, lease census, and —
+under `--gc --dry-run` — the set of blobs a collection pass would
+remove. `--repair` extends to the store (quarantine + heal from
+duplicate referencers); `--gc` WITHOUT `--dry-run` actually runs the
+lease-guarded collection. Store health, like serving, never affects
+the exit code: store artifacts are re-publishable by construction.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -62,6 +73,23 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="emit the report as JSON"
     )
+    parser.add_argument(
+        "--store",
+        default=None,
+        help="artifact store root to audit (default: <model_dir>/store "
+        "when that directory exists)",
+    )
+    parser.add_argument(
+        "--gc",
+        action="store_true",
+        help="run a lease-guarded GC pass on the store (report-only "
+        "with --dry-run)",
+    )
+    parser.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="with --gc: compute the would-GC set without deleting",
+    )
     args = parser.parse_args(argv)
 
     from adanet_tpu.robustness import integrity
@@ -72,9 +100,30 @@ def main(argv=None) -> int:
     # generation), so operators can vet a flip BEFORE it happens.
     serving = integrity.serving_report(args.model_dir)
 
+    store_root = args.store
+    if store_root is None:
+        candidate = os.path.join(args.model_dir, "store")
+        if os.path.isdir(candidate):
+            store_root = candidate
+    store = None
+    if store_root is not None:
+        store = integrity.store_report(
+            store_root,
+            repair=args.repair,
+            gc_dry_run=args.gc and args.dry_run,
+        )
+        if args.gc and not args.dry_run:
+            from adanet_tpu.store import ArtifactStore, collect
+
+            store["gc"] = collect(
+                ArtifactStore(store_root)
+            ).to_json()
+
     if args.json:
         obj = report.to_json()
         obj["serving"] = serving
+        if store is not None:
+            obj["store"] = store
         print(json.dumps(obj, sort_keys=True))
     else:
         if report.fresh:
@@ -127,6 +176,41 @@ def main(argv=None) -> int:
                     else "nothing (no eligible generation)"
                 )
             )
+        if store is not None:
+            print(
+                "store %s: %d blobs (%d bytes), %d refs, %s"
+                % (
+                    store["root"],
+                    store["blob_count"],
+                    store["bytes"],
+                    store["ref_count"],
+                    "clean" if store["clean"] else "NOT CLEAN",
+                )
+            )
+            for digest in store["corrupt_blobs"]:
+                print("store ISSUE: corrupt blob %s" % digest)
+            for entry in store["dangling_refs"]:
+                print("store ISSUE: dangling ref %s" % entry)
+            for digest in store["healed_blobs"]:
+                print("store healed: %s" % digest)
+            if store["quarantined_blobs"]:
+                print(
+                    "store quarantined copies: %d"
+                    % len(store["quarantined_blobs"])
+                )
+            if "would_gc" in store:
+                print(
+                    "store GC dry run would remove %d blobs"
+                    % len(store["would_gc"])
+                )
+            if "gc" in store:
+                print(
+                    "store GC removed %d blobs, pruned %d leases"
+                    % (
+                        len(store["gc"]["removed"]),
+                        len(store["gc"]["pruned_leases"]),
+                    )
+                )
 
     return report.exit_code
 
@@ -135,8 +219,6 @@ if __name__ == "__main__":
     # Direct-script invocation (`python tools/ckpt_fsck.py ...`) must
     # find the repo package without an installed distribution; `-m`
     # invocations already have the repo root on sys.path.
-    import os
-
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )

@@ -691,6 +691,14 @@ class HostModuleJnpRule(Rule):
         "serving/frontend.py",
         "serving/model_pool.py",
         "serving/publisher.py",
+        # The artifact store is pure host I/O (digests, renames,
+        # leases, GC) — the accelerator never appears on its data path.
+        "store/__init__.py",
+        "store/blobstore.py",
+        "store/fsck.py",
+        "store/gc.py",
+        "store/keys.py",
+        "store/leases.py",
     )
 
     def check(self, ctx: FileContext) -> List[Finding]:
@@ -877,13 +885,17 @@ class UnboundedWaitRule(Rule):
     }
     #: blocking attribute call -> count of positional args that already
     #: includes the bound (the jax coordination client takes the timeout
-    #: positionally after the key; wait/join take it first).
+    #: positionally after the key; wait/join take it first; the
+    #: artifact store's ref wait takes it after (kind, name) — its
+    #: lease/claim waits must be bounded like every other coordination
+    #: surface).
     _BOUNDED_AT = {
         "blocking_key_value_get": 2,
         "blocking_key_value_get_bytes": 2,
         "wait_at_barrier": 2,
         "wait": 1,
         "join": 1,
+        "wait_for_ref": 3,
     }
 
     def check(self, ctx: FileContext) -> List[Finding]:

@@ -239,6 +239,7 @@ def quarantine_file(model_dir: str, filename: str) -> Optional[str]:
         n += 1
         target = "%s%s.%d" % (filename, QUARANTINE_SUFFIX, n)
     try:
+        # jaxlint: disable=JL013(quarantine moves already-landed corrupt bytes aside; no payload is written, so there is nothing to stage or fsync)
         os.replace(path, os.path.join(model_dir, target))
     except FileNotFoundError:
         # Concurrent healing (several processes of a multi-host run all
@@ -247,6 +248,7 @@ def quarantine_file(model_dir: str, filename: str) -> Optional[str]:
         return None
     sidecar = digest_path(model_dir, filename)
     try:
+        # jaxlint: disable=JL013(sidecar rides along with the quarantined artifact; same no-payload rename)
         os.replace(
             sidecar, os.path.join(model_dir, target + DIGEST_SUFFIX)
         )

@@ -70,6 +70,7 @@ from adanet_tpu.robustness import faults as faults_lib
 from adanet_tpu.robustness import retry as retry_lib
 from adanet_tpu.robustness import watchdog as watchdog_lib
 from adanet_tpu.utils import (
+    EVAL_FETCH_WINDOW,
     WeightedMeanAccumulator,
     batch_example_count,
     batch_metric_weight,
@@ -2128,6 +2129,24 @@ class Estimator:
         # (ADVICE round 1).
         acc = WeightedMeanAccumulator()
         custom_acc = WeightedMeanAccumulator()
+        # Dispatch metrics programs without a per-batch fetch: a
+        # device_get inside the loop drains the pipeline once per batch
+        # (jaxlint JL012). Outputs are scalar-sized, so they stage on
+        # device and come back in batched transfers — but the window is
+        # BOUNDED: an unbounded stage would let the host loop run
+        # arbitrarily ahead and accumulate every batch's input buffers
+        # on device.
+        staged = []
+
+        def drain():
+            for (host, host_custom), n, n_examples in jax.device_get(
+                staged
+            ):
+                acc.add(host, n)
+                if host_custom:
+                    custom_acc.add(host_custom, n_examples)
+            staged.clear()
+
         for features, labels in self._eval_batches(data, steps):
             batch = (features, labels)
             n = batch_metric_weight(
@@ -2137,12 +2156,12 @@ class Estimator:
             )
             n_examples = batch_example_count(batch)
             features, labels = self._place_batch(batch)
-            host, host_custom = jax.device_get(
-                metrics_fn(params, features, labels)
+            staged.append(
+                (metrics_fn(params, features, labels), n, n_examples)
             )
-            acc.add(host, n)
-            if host_custom:
-                custom_acc.add(host_custom, n_examples)
+            if len(staged) >= EVAL_FETCH_WINDOW:
+                drain()
+        drain()
         result = acc.means()
         if custom_acc.batches:
             result.update(custom_acc.means())
@@ -2270,9 +2289,20 @@ class Estimator:
             ensemble = forward(params, features)
             return self._predictions_with_member_outputs(ensemble)
 
+        # Double-buffered: batch i+1's program is dispatched before batch
+        # i's outputs are pulled, so the transfer overlaps the next
+        # compute. The in-loop fetch itself is the generator's contract —
+        # callers receive host arrays per batch.
+        pending = None
         for batch in self._eval_batches(data, None):
             features = batch[0] if isinstance(batch, tuple) else batch
-            yield jax.device_get(predict_fn(params, features))
+            current = predict_fn(params, features)
+            if pending is not None:
+                # jaxlint: disable=JL012(double-buffered: this fetch overlaps batch i+1's dispatched compute)
+                yield jax.device_get(pending)
+            pending = current
+        if pending is not None:
+            yield jax.device_get(pending)
 
     # --------------------------------------------------- artifact store
 

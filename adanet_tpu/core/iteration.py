@@ -896,23 +896,18 @@ class Iteration:
         features, _ = split_example_weights(
             features, self.weight_key, require=False
         )
-        params = jax.device_get(state.ensembles[espec.name].params)
-        weights = None
-        if isinstance(params, dict):
-            weights = params.get("weights")
-
-        weighted = []
+        # Stage every member's device values first, then pull them to the
+        # host in ONE device_get: per-member fetches inside the loop
+        # serialize N blocking round-trips (and stall the dispatch of the
+        # next member's `_frozen_record_fields` program — jaxlint JL012);
+        # one batched fetch overlaps all the record-field computes and
+        # pays a single transfer latency at the freeze boundary.
+        device_fetch = {"ensembler": state.ensembles[espec.name].params}
+        member_plans = []
         for i, (kind, ref) in enumerate(espec.members):
             if kind == _FROZEN:
-                frozen = self.frozen_subnetworks[ref]
-                frozen = FrozenSubnetwork(
-                    iteration_number=frozen.iteration_number,
-                    name=frozen.name,
-                    module=frozen.module,
-                    params=jax.device_get(state.frozen[ref]),
-                    complexity=frozen.complexity,
-                    shared=frozen.shared,
-                )
+                device_fetch["member/%d" % i] = state.frozen[ref]
+                member_plans.append((i, kind, self.frozen_subnetworks[ref]))
             else:
                 spec = next(
                     s for s in self.subnetwork_specs if s.name == ref
@@ -926,15 +921,35 @@ class Iteration:
                 # multi-host SPMD the batch-shaped outputs (last_layer,
                 # logits) span non-addressable devices and must not be
                 # device_get here.
-                out = _frozen_record_fields(
+                device_fetch["member/%d" % i] = device_variables
+                device_fetch["record/%d" % i] = _frozen_record_fields(
                     _ModuleHandle(spec.module), device_variables, features
                 )
-                complexity, shared = jax.device_get(out)
+                member_plans.append((i, kind, spec))
+        host = jax.device_get(device_fetch)
+        params = host["ensembler"]
+        weights = None
+        if isinstance(params, dict):
+            weights = params.get("weights")
+
+        weighted = []
+        for i, kind, member in member_plans:
+            if kind == _FROZEN:
+                frozen = FrozenSubnetwork(
+                    iteration_number=member.iteration_number,
+                    name=member.name,
+                    module=member.module,
+                    params=host["member/%d" % i],
+                    complexity=member.complexity,
+                    shared=member.shared,
+                )
+            else:
+                complexity, shared = host["record/%d" % i]
                 frozen = FrozenSubnetwork(
                     iteration_number=self.iteration_number,
-                    name=spec.name,
-                    module=spec.module,
-                    params=jax.device_get(device_variables),
+                    name=member.name,
+                    module=member.module,
+                    params=host["member/%d" % i],
                     complexity=complexity,
                     shared=shared,
                 )

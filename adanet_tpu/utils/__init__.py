@@ -1,6 +1,7 @@
 """Shared utilities."""
 
 from adanet_tpu.utils.batches import (
+    EVAL_FETCH_WINDOW,
     WeightedMeanAccumulator,
     batch_example_count,
     batch_metric_weight,
@@ -10,6 +11,7 @@ from adanet_tpu.utils.trees import tree_where
 from adanet_tpu.utils.trees import tree_zeros_like
 
 __all__ = [
+    "EVAL_FETCH_WINDOW",
     "WeightedMeanAccumulator",
     "batch_example_count",
     "batch_metric_weight",

@@ -48,6 +48,13 @@ def batch_metric_weight(batch, weight_key=None, collective=False) -> float:
     return float(batch_example_count(batch))
 
 
+#: Eval batches dispatched between host fetches in the staged eval
+#: loops (Estimator.evaluate, experimental Model.evaluate): deep enough
+#: to keep the device pipeline busy, bounded so in-flight input buffers
+#: cannot grow with the dataset — the fetch backpressures every window.
+EVAL_FETCH_WINDOW = 32
+
+
 def batch_example_count(batch) -> int:
     """Number of examples in a (features, labels) batch.
 

@@ -1,0 +1,34 @@
+"""Buried findings: a bf16 module whose helpers upcast and sync.
+
+`scale` is reached from the jit entry in step.py through an aliased
+import; `_renorm` (f32 upcast, JL010) and `leaf_norm` (host sync,
+JL002) sit one and two more frames down. `draw_pair` reuses a PRNG key
+through a consuming helper (JL005 transitive consumption).
+"""
+import jax
+import jax.numpy as jnp
+
+COMPUTE_DTYPE = jnp.bfloat16
+
+
+def scale(x):
+    return _renorm(x.astype(COMPUTE_DTYPE))
+
+
+def _renorm(x):
+    y = x.astype(jnp.float32)  # JL010: upcast 3 frames below the entry
+    return y / leaf_norm(x)
+
+
+def leaf_norm(x):
+    return x.sum().item()  # JL002: host sync 4 frames below the entry
+
+
+def draw_pair(key):
+    a = _sample(key)
+    b = _sample(key)  # JL005: second consumption without a split
+    return a, b
+
+
+def _sample(key):
+    return jax.random.normal(key, (2,))

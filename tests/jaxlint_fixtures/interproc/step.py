@@ -1,0 +1,22 @@
+"""Interprocedural seed: a jitted step whose sins live elsewhere.
+
+The jit wrap is `jax.jit(self._step_impl, ...)` — a `self.` method
+reference — and every finding is buried 2-4 frames below it, across an
+ALIASED import (`metrics as metrics_lib`). The engine must resolve the
+whole chain and report it in each finding message
+(tests/test_jaxlint.py::test_interprocedural_chain_attribution).
+"""
+import jax
+
+from tests.jaxlint_fixtures.interproc import metrics as metrics_lib
+
+
+class Trainer:
+    def __init__(self):
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+
+    def _step_impl(self, state, batch):
+        return self._midpoint(state, batch)
+
+    def _midpoint(self, state, batch):
+        return metrics_lib.scale(state + batch)

@@ -1,0 +1,19 @@
+"""A non-atomic persistence write buried two `self.` calls deep.
+
+Lives under an `.../store/` path so the JL013 persistence scope
+applies; the open() at a final path is in `_write_raw`, reached from
+the public `save` through `_persist`.
+"""
+import json
+
+
+class ReportWriter:
+    def save(self, path, obj):
+        self._persist(path, obj)
+
+    def _persist(self, path, obj):
+        self._write_raw(path, json.dumps(obj))
+
+    def _write_raw(self, path, text):
+        with open(path, "w") as f:  # JL013: direct write, no staging
+            f.write(text)

@@ -1,0 +1,18 @@
+"""JL010 bad: f32 upcast / f64 on the compute path of a bf16 module."""
+import jax
+import jax.numpy as jnp
+
+# bf16 compute policy: params live in f32, compute runs in bfloat16.
+COMPUTE_DTYPE = jnp.bfloat16
+
+
+@jax.jit
+def fused_forward(params, batch):
+    x = batch.astype(COMPUTE_DTYPE)
+    return _project(params, x)
+
+
+def _project(params, x):
+    w = params["w"].astype(jnp.float32)  # expect: JL010
+    y = jnp.asarray(x, dtype=jnp.float64)  # expect: JL010
+    return w @ y

@@ -1,0 +1,21 @@
+"""JL010 good: compute stays bf16; f32 only off the traced path."""
+import jax
+import jax.numpy as jnp
+
+COMPUTE_DTYPE = jnp.bfloat16
+
+
+@jax.jit
+def fused_forward(params, batch):
+    x = batch.astype(COMPUTE_DTYPE)
+    return _project(params, x)
+
+
+def _project(params, x):
+    w = params["w"].astype(COMPUTE_DTYPE)
+    return w @ x
+
+
+def export_params(params):
+    # Host-side export, not reachable from the jit entry: f32 is fine.
+    return {k: v.astype(jnp.float32) for k, v in params.items()}

@@ -1,0 +1,15 @@
+"""JL011 bad: loop-invariant constructors inside a lax.scan body."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def run(carry, xs):
+    def body(c, x):
+        iota = jnp.arange(128)  # expect: JL011
+        table = jnp.eye(8)  # expect: JL011
+        return c + x * iota.sum() + table.sum(), None
+
+    out, _ = lax.scan(body, carry, xs)
+    return out

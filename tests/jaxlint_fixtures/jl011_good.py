@@ -1,0 +1,17 @@
+"""JL011 good: invariants hoisted; carry-dependent work stays inside."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def run(carry, xs):
+    iota = jnp.arange(128)  # hoisted: materialized once
+    table = jnp.eye(8)
+
+    def body(c, x):
+        scale = jnp.full((8,), c)  # depends on the carry: not invariant
+        return c + x * iota.sum() + (table * scale).sum(), None
+
+    out, _ = lax.scan(body, carry, xs)
+    return out

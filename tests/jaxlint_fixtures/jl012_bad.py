@@ -1,0 +1,20 @@
+"""JL012 bad: per-step device->host transfers in the dispatch loop."""
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state + batch.sum()
+
+
+def fit(state, batches):
+    losses = []
+    for batch in batches:
+        state = train_step(state, batch)
+        losses.append(np.asarray(state))  # expect: JL012
+        running = state.item()  # expect: JL012
+        del running
+    return state, losses

@@ -1,0 +1,16 @@
+"""JL013 bad: direct writes at final paths in a persistence module.
+
+Linted under the virtual path `adanet_tpu/store/fixture_writer.py` so
+the persistence-module scope applies.
+"""
+import json
+import os
+
+
+def save_manifest(path, obj):
+    with open(path, "w") as f:  # expect: JL013
+        json.dump(obj, f)
+
+
+def publish(tmp, final):
+    os.replace(tmp, final)  # expect: JL013

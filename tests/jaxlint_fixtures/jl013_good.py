@@ -1,0 +1,32 @@
+"""JL013 good: staged+fsync+rename, directly and by delegation."""
+import json
+import os
+import tempfile
+
+
+def save_manifest(root, path, obj):
+    data = json.dumps(obj).encode()
+    fd, tmp = tempfile.mkstemp(dir=root)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        os.unlink(tmp)
+        raise
+
+
+def publish(root, path, obj):
+    # Delegation satisfies the idiom: the closure stages+fsyncs+renames.
+    _atomic_write(root, path, json.dumps(obj).encode())
+
+
+def _atomic_write(root, path, data):
+    fd, tmp = tempfile.mkstemp(dir=root)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,18 @@
+"""JL014 bad: two locks taken in opposite orders on two paths."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._flip_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def flip(self):
+        with self._flip_lock:
+            with self._stats_lock:  # expect: JL014
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            with self._flip_lock:  # expect: JL014
+                pass

@@ -1,0 +1,18 @@
+"""JL014 good: one global lock order on every path (flip before stats)."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._flip_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def flip(self):
+        with self._flip_lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._flip_lock:
+            with self._stats_lock:
+                pass

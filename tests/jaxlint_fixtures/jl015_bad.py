@@ -1,0 +1,24 @@
+"""JL015 bad: a dead registry entry, a chaos blind spot, a typo'd trip.
+
+Linted under the virtual path `adanet_tpu/robustness/faults.py` so the
+registry discovery applies. Site names are fixture-unique so the real
+tests tree can never accidentally "arm" them.
+"""
+FAULT_SITES = frozenset(
+    {
+        "jl015fix.dead",  # expect: JL015
+        "jl015fix.unarmed",  # expect: JL015
+    }
+)
+
+
+def write_payload():
+    trip("jl015fix.unarmed")
+
+
+def read_payload():
+    trip("jl015fix.typo")  # expect: JL015
+
+
+def trip(site):
+    del site

@@ -1,0 +1,18 @@
+"""JL015 good: the registered site is tripped AND armed by a test."""
+FAULT_SITES = frozenset({"jl015ok.write"})
+
+
+def write_payload():
+    trip("jl015ok.write")
+
+
+def test_write_payload_fault():
+    arm("jl015ok.write", "error")
+
+
+def trip(site):
+    del site
+
+
+def arm(site, mode):
+    del site, mode

@@ -137,6 +137,76 @@ def test_renew_extends_lease():
     assert peer.claim(*ALWAYS) is None
 
 
+def test_lease_renew_fault_site_error_and_transient():
+    """Chaos coverage for the `lease.renew` fault site (jaxlint JL015).
+
+    The renewal heartbeat is best-effort: an injected failure must
+    surface to the renewer (which logs and retries next interval) while
+    the PRIOR lease stays intact — a flaky KV write costs one missed
+    heartbeat, never a lost unit.
+    """
+    from adanet_tpu.robustness import faults
+    from adanet_tpu.robustness.faults import (
+        InjectedFault,
+        InjectedTransientError,
+    )
+
+    clock = FakeClock()
+    kv, q = _queue(clock)
+    q.publish([WorkUnit("subnetwork", "a", 0, 4)])
+    unit, attempt = q.claim(*ALWAYS)
+
+    faults.arm("lease.renew", "error", after=0, count=1)
+    try:
+        with pytest.raises(InjectedFault):
+            q.renew(unit, attempt)
+    finally:
+        faults.disarm()
+    # The fault fired BEFORE the lease write: the claim-time lease is
+    # untouched, so the unit is still owned and a clean renewal extends.
+    clock.advance(q.config.lease_ttl_secs * 0.5)
+    q.renew(unit, attempt)
+    peer = _peer(kv, q, "p1", clock)
+    assert peer.claim(*ALWAYS) is None  # still leased by p0
+
+    # Transient mode satisfies retry.is_transient (an OSError), the
+    # contract the bounded-retry helpers key on.
+    faults.arm("lease.renew", "transient", after=0, count=1)
+    try:
+        with pytest.raises(InjectedTransientError):
+            q.renew(unit, attempt)
+    finally:
+        faults.disarm()
+    q.renew(unit, attempt)  # clean again
+
+
+def test_lease_renewer_absorbs_renewal_fault():
+    """`LeaseRenewer` (the production heartbeat thread) treats an
+    injected renewal failure as best-effort — `lost` stays None and the
+    worker's unit completes normally."""
+    from adanet_tpu.distributed.scheduler import LeaseRenewer
+    from adanet_tpu.robustness import faults
+
+    clock = FakeClock()
+    kv = InMemoryKV()
+    config = WorkQueueConfig(lease_ttl_secs=0.2, poll_interval_secs=0.0)
+    q = WorkQueue(kv, "ns", config, worker="p0", clock=clock)
+    q.publish([WorkUnit("subnetwork", "a", 0, 4)])
+    unit, attempt = q.claim(*ALWAYS)
+    faults.arm("lease.renew", "error", after=0, count=1)
+    try:
+        with LeaseRenewer(q, unit, attempt) as renewer:
+            deadline = time.time() + 5.0
+            spec = faults.armed().get("lease.renew")
+            while spec.trips < 1 and time.time() < deadline:
+                time.sleep(0.01)
+        assert spec.trips == 1  # the heartbeat really hit the seam
+        assert renewer.lost is None  # best-effort: not a lost lease
+    finally:
+        faults.disarm()
+    assert q.complete(unit, attempt, b"result") is True
+
+
 def test_attempts_exhausted_poisons_candidate():
     clock = FakeClock()
     kv, q = _queue(clock, max_attempts=2)

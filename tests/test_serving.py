@@ -330,6 +330,29 @@ def test_serving_model_load_fault_site(tmp_path):
     assert pool.stats()["rollbacks"] == 1
 
 
+def test_serving_batch_execute_fault_is_orderly_error(tmp_path):
+    """Chaos coverage for `serving.batch_execute` (jaxlint JL015): a
+    compiled program failing under live traffic answers the in-flight
+    request as the orderly 5xx-equivalent — and the plane survives, so
+    the very next dispatch succeeds."""
+    pool = _stub_pool(str(tmp_path), generations=(0,))
+    pool.poll()
+    frontend = ServingFrontend(
+        Batcher(pool, BatcherConfig(bucket_sizes=(2, 4), jit=False))
+    ).start()
+    faults.arm("serving.batch_execute", "error", after=0, count=1)
+    try:
+        result = frontend.submit({"x": np.ones((2, 3), np.float32)})
+        assert result.status == "error"
+        assert "InjectedFault" in result.error
+        # The plane stayed healthy: the next batch executes cleanly.
+        ok = frontend.submit({"x": np.ones((2, 3), np.float32)})
+        assert ok.ok
+    finally:
+        faults.disarm()
+        frontend.drain(timeout=10.0)
+
+
 def test_fsck_json_reports_serving_eligibility(tmp_path, capsys):
     """`ckpt_fsck --json` flags which generation the serving plane
     would select (`serving_eligible` per generation)."""

@@ -5,13 +5,27 @@ Usage:
 
 Rules (see docs/jaxlint.md for bad/good pairs):
     JL001 Python side effects inside jitted functions (tracer leaks)
-    JL002 host-device syncs on jit-traced hot paths
+    JL002 host-device syncs on jit-traced hot paths (interprocedural)
     JL003 tracer concretization / retrace hazards (f-string, assert, str)
-    JL004 step-like jitted functions missing donate_argnums
-    JL005 PRNG key reuse without split/fold_in
+    JL004 step-like jitted functions missing donate_argnums (incl. wraps)
+    JL005 PRNG key reuse without split/fold_in (transitive consumption)
     JL006 jnp in host-only data-path modules
     JL007 pjit/shard_map entry points without explicit shardings
     JL008 Python branches on traced values inside jitted code
+    JL009 unbounded coordination waits (incl. timeout=None wrappers)
+    -- perf pack (rules_perf.py) --
+    JL010 dtype promotion (f32 upcast / f64) on bf16 compute paths
+    JL011 loop-invariant constructors inside scan/loop bodies
+    JL012 per-step device->host transfers in the host training loop
+    -- protocol pack (rules_protocol.py) --
+    JL013 non-atomic persistence writes (missing stage+fsync+rename)
+    JL014 lock-order inversions (potential deadlock cycles)
+    JL015 fault-site registry out of sync with trips / armed tests
+
+Interprocedural rules run over a whole-repo call graph
+(`tools/jaxlint/callgraph.py`): imports (aliased), `self.`/class
+methods, and traced function references (scan bodies, CachedStep) all
+resolve, and findings report the full call chain from the jit entry.
 
 Suppress inline with `# jaxlint: disable=JL001(reason)` (same line or
 the line above), file-wide with `# jaxlint: disable-file=JL006(reason)`,
@@ -21,11 +35,14 @@ or grandfather via `tools/jaxlint/baseline.json` (regenerate with
 
 from tools.jaxlint.engine import (
     Finding,
+    ProjectContext,
+    build_project,
     default_baseline_path,
     lint_source,
     load_baseline,
     main,
     run_paths,
+    update_baseline,
     write_baseline,
 )
 from tools.jaxlint.rules import ALL_RULES, RULES_BY_ID
@@ -34,10 +51,13 @@ __all__ = [
     "ALL_RULES",
     "RULES_BY_ID",
     "Finding",
+    "ProjectContext",
+    "build_project",
     "default_baseline_path",
     "lint_source",
     "load_baseline",
     "main",
     "run_paths",
+    "update_baseline",
     "write_baseline",
 ]

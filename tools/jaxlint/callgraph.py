@@ -1,0 +1,520 @@
+"""Whole-repo call graph for jaxlint's interprocedural rules.
+
+The PR-1 analyzer resolved calls by bare last-component name inside one
+file, so `self._helper()`, `ckpt.write_json(...)` (aliased import), and
+anything one module away were invisible. This module builds a
+project-wide graph with real resolution:
+
+- **Modules**: every linted file becomes a module keyed by its
+  repo-relative path; its dotted name is derived from the path so
+  `from adanet_tpu.core import checkpoint as ckpt` links up.
+- **Functions**: module-level functions, class methods, and nested
+  `def`s all get stable qualified names
+  (`path::Class.method`, `path::outer.<locals>.inner`).
+- **Imports**: `import a.b as c`, `from a.b import f as g`, and
+  `from a import b` all resolve through the per-module alias table.
+- **Methods**: `self.m()` / `cls.m()` resolve within the enclosing
+  class, then through project-resolvable base classes.
+- **References**: a function *referenced* (not called) inside a call —
+  `lax.scan(body, ...)`, `functools.partial(step, ...)`,
+  `CachedStep(self._impl, ...)` — adds an edge too, because the callee
+  runs under the caller's trace. Reference edges are what let a host
+  sync inside a `lax.scan` step body attribute to the jit entry.
+
+Resolution is conservative: an unresolvable call contributes no edge
+(never a guessed one), so interprocedural findings can miss but not
+fabricate call chains.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.engine import FileContext
+
+
+# ------------------------------------------------- jit-detection helpers
+# (Shared by rules.py; they live here so the graph can classify jit
+# entries without importing the rule set — callgraph is the lower layer.)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Attribute/Name chains, else None."""
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return "%s.%s" % (base, node.attr) if base else None
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """True for an expression naming a jit-family transform."""
+    name = dotted_name(node)
+    if not name:
+        return False
+    return name.split(".")[-1] in {"jit", "pjit"}
+
+
+def jit_decorator_kwargs(dec: ast.AST) -> Optional[Set[str]]:
+    """If `dec` is a jit-family decorator, the keyword names it passes.
+
+    Handles `@jax.jit`, `@jit`, `@pjit`, `@jax.jit(...)`, and
+    `@functools.partial(jax.jit, ...)`. Returns None for non-jit
+    decorators.
+    """
+    if is_jit_expr(dec):
+        return set()
+    if isinstance(dec, ast.Call):
+        if is_jit_expr(dec.func):
+            return {kw.arg for kw in dec.keywords if kw.arg}
+        func = dotted_name(dec.func)
+        if (
+            func
+            and func.split(".")[-1] == "partial"
+            and dec.args
+            and is_jit_expr(dec.args[0])
+        ):
+            return {kw.arg for kw in dec.keywords if kw.arg}
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function/method in the project."""
+
+    qualname: str  # "path::Class.method" / "path::fn" / "...<locals>.inner"
+    path: str
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: Optional[str] = None
+    parent: Optional[str] = None  # enclosing function qualname, if nested
+
+    @property
+    def display(self) -> str:
+        return "%s::%s" % (self.path, self.qualname.split("::", 1)[1])
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    methods: Dict[str, str]  # method name -> function qualname
+    bases: List[str]  # base-class dotted names as written
+
+
+class ModuleInfo:
+    """Per-file symbol tables: imports, functions, classes."""
+
+    def __init__(self, path: str, dotted: str):
+        self.path = path
+        self.dotted = dotted
+        #: local alias -> dotted target ("np" -> "numpy",
+        #: "ckpt" -> "adanet_tpu.core.checkpoint",
+        #: "write_json" -> "adanet_tpu.core.checkpoint.write_json")
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, str] = {}  # top-level name -> qualname
+        self.classes: Dict[str, ClassInfo] = {}
+        #: instance attr -> wrapped function qualname, for
+        #: `self._step = CachedStep(self._step_impl, ...)` style wrappers.
+        self.attr_wrappers: Dict[str, str] = {}
+
+
+def module_dotted_name(path: str) -> str:
+    """`adanet_tpu/core/estimator.py` -> `adanet_tpu.core.estimator`."""
+    name = path[:-3] if path.endswith(".py") else path
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+_WRAP_CALLS = {"jit", "pjit", "CachedStep", "partial", "scan", "fori_loop",
+               "while_loop", "cond", "vmap", "grad", "value_and_grad",
+               "checkpoint", "remat", "shard_map"}
+
+
+class CallGraph:
+    """The project graph: functions, edges, jit entries."""
+
+    def __init__(self, files: Dict[str, FileContext]):
+        self.files = files
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.modules: Dict[str, ModuleInfo] = {}
+        self._by_dotted: Dict[str, ModuleInfo] = {}
+        #: caller qualname -> callee qualnames (calls + references)
+        self.edges: Dict[str, Set[str]] = {}
+        #: caller qualname -> direct-call-only callee qualnames
+        self.call_edges: Dict[str, Set[str]] = {}
+        #: function AST node id -> qualname, for rules walking their own
+        #: file that need to enter the graph at a node they hold.
+        self.qualname_of_node: Dict[int, str] = {}
+        self._index()
+        #: any AST node id -> innermost enclosing FunctionInfo. Built
+        #: once so wrap-site/assign-site lookups are O(1) instead of a
+        #: per-call scan over every function's subtree.
+        self._enclosing: Dict[int, FunctionInfo] = {}
+        for qual in self.functions:
+            info = self.functions[qual]
+            for node in _scope_nodes(info.node):
+                self._enclosing[id(node)] = info
+        self._link()
+        self.jit_entries = self._find_jit_entries()
+
+    # ------------------------------------------------------------ indexing
+
+    def _index(self) -> None:
+        for path in sorted(self.files):
+            ctx = self.files[path]
+            mod = ModuleInfo(path, module_dotted_name(path))
+            self.modules[path] = mod
+            self._by_dotted[mod.dotted] = mod
+            self._index_imports(mod, ctx.tree)
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_function(mod, node, prefix="", class_name=None)
+                elif isinstance(node, ast.ClassDef):
+                    self._index_class(mod, node)
+
+    def _index_imports(self, mod: ModuleInfo, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    mod.imports[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # Relative import: resolve against this module's package.
+                    parts = mod.dotted.split(".")
+                    base = ".".join(parts[: len(parts) - node.level])
+                    if node.module:
+                        source = (
+                            "%s.%s" % (base, node.module)
+                            if base
+                            else node.module
+                        )
+                    else:
+                        source = base  # `from . import x`
+                elif node.module:
+                    source = node.module
+                else:
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    mod.imports[local] = (
+                        "%s.%s" % (source, alias.name)
+                        if source
+                        else alias.name
+                    )
+
+    def _index_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        info = ClassInfo(
+            name=node.name,
+            path=mod.path,
+            methods={},
+            bases=[d for d in map(_dotted, node.bases) if d],
+        )
+        mod.classes[node.name] = info
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = self._add_function(
+                    mod, child, prefix=node.name + ".", class_name=node.name
+                )
+                info.methods[child.name] = qual
+
+    def _add_function(
+        self,
+        mod: ModuleInfo,
+        node: ast.AST,
+        prefix: str,
+        class_name: Optional[str],
+        parent: Optional[str] = None,
+    ) -> str:
+        qual = "%s::%s%s" % (mod.path, prefix, node.name)
+        info = FunctionInfo(
+            qualname=qual,
+            path=mod.path,
+            name=node.name,
+            node=node,
+            class_name=class_name,
+            parent=parent,
+        )
+        self.functions[qual] = info
+        self.qualname_of_node[id(node)] = qual
+        if not parent and not class_name:
+            mod.functions[node.name] = qual
+        # Nested defs: indexed under "<locals>" so bare calls in the
+        # enclosing body resolve to them first.
+        for child in ast.walk(node):
+            if child is node:
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if id(child) not in self.qualname_of_node and _directly_nested(
+                    node, child
+                ):
+                    self._add_function(
+                        mod,
+                        child,
+                        prefix=prefix + node.name + ".<locals>.",
+                        class_name=class_name,
+                        parent=qual,
+                    )
+        return qual
+
+    # ----------------------------------------------------------- resolving
+
+    def resolve(
+        self, name: str, mod: ModuleInfo, scope: Optional[FunctionInfo]
+    ) -> Optional[str]:
+        """Resolves a dotted call target to a function qualname, or None."""
+        if not name:
+            return None
+        parts = name.split(".")
+        head = parts[0]
+
+        # self.m / cls.m -> method of the enclosing class (or bases).
+        # Exactly two parts: `self.head.loss(...)` dispatches through an
+        # instance attribute whose type we do not track — unresolved.
+        if head in ("self", "cls") and scope is not None and len(parts) == 2:
+            return self._resolve_method(mod, scope.class_name, parts[1])
+
+        # Nested function of the enclosing scope chain.
+        if len(parts) == 1 and scope is not None:
+            cursor: Optional[FunctionInfo] = scope
+            while cursor is not None:
+                nested = "%s.<locals>.%s" % (cursor.qualname, head)
+                if nested in self.functions:
+                    return nested
+                cursor = (
+                    self.functions.get(cursor.parent)
+                    if cursor.parent
+                    else None
+                )
+
+        # Module-level function in this module.
+        if len(parts) == 1 and head in mod.functions:
+            return mod.functions[head]
+
+        # ClassName.method within this module.
+        if len(parts) == 2 and head in mod.classes:
+            return mod.classes[head].methods.get(parts[1])
+
+        # Through the import table: alias -> dotted target.
+        if head in mod.imports:
+            target = mod.imports[head] + (
+                "." + ".".join(parts[1:]) if len(parts) > 1 else ""
+            )
+            return self._resolve_dotted(target)
+        return None
+
+    def _resolve_dotted(self, dotted: str) -> Optional[str]:
+        """`adanet_tpu.core.checkpoint.write_json` -> its qualname."""
+        parts = dotted.split(".")
+        # Longest module prefix wins: a.b.c.f with a.b.c a module -> f.
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self._by_dotted.get(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                if rest[0] in mod.functions:
+                    return mod.functions[rest[0]]
+                # `from a.b import f` where a.b re-exports f from a.b.f? —
+                # unresolved, stay conservative.
+                return None
+            if len(rest) == 2 and rest[0] in mod.classes:
+                return mod.classes[rest[0]].methods.get(rest[1])
+            return None
+        return None
+
+    def _resolve_method(
+        self, mod: ModuleInfo, class_name: Optional[str], method: str
+    ) -> Optional[str]:
+        seen: Set[Tuple[str, str]] = set()
+        stack = [(mod, class_name)] if class_name else []
+        while stack:
+            cur_mod, cname = stack.pop()
+            if not cname or (cur_mod.path, cname) in seen:
+                continue
+            seen.add((cur_mod.path, cname))
+            cls = cur_mod.classes.get(cname)
+            if cls is None:
+                continue
+            if method in cls.methods:
+                return cls.methods[method]
+            for base in cls.bases:
+                parts = base.split(".")
+                if len(parts) == 1 and parts[0] in cur_mod.classes:
+                    stack.append((cur_mod, parts[0]))
+                elif parts[0] in cur_mod.imports:
+                    target = cur_mod.imports[parts[0]]
+                    if len(parts) > 1:
+                        target += "." + ".".join(parts[1:])
+                    tparts = target.split(".")
+                    base_mod = self._by_dotted.get(".".join(tparts[:-1]))
+                    if base_mod is not None:
+                        stack.append((base_mod, tparts[-1]))
+        return None
+
+    # ------------------------------------------------------------- linking
+
+    def _link(self) -> None:
+        for path in sorted(self.modules):
+            self._collect_attr_wrappers(self.modules[path])
+        for qual in sorted(self.functions):
+            info = self.functions[qual]
+            mod = self.modules[info.path]
+            calls: Set[str] = set()
+            refs: Set[str] = set()
+            for node in _scope_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _dotted(node.func)
+                resolved = self.resolve(target, mod, info) if target else None
+                if resolved:
+                    calls.add(resolved)
+                # Function references passed into wrappers/transforms run
+                # under the caller: scan bodies, partials, CachedStep.
+                last = (target or "").split(".")[-1]
+                if last in _WRAP_CALLS or resolved is None:
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        ref = _dotted(arg)
+                        if not ref:
+                            continue
+                        ref_resolved = self.resolve(ref, mod, info)
+                        if ref_resolved:
+                            refs.add(ref_resolved)
+            self.call_edges[qual] = calls
+            self.edges[qual] = calls | refs
+
+        # Attribute-wrapper dispatch: `self._train_step(...)` where the
+        # attr was assigned a CachedStep/jit wrapper resolves to the
+        # wrapped implementation.
+        for qual in sorted(self.functions):
+            info = self.functions[qual]
+            mod = self.modules[info.path]
+            for node in _scope_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _dotted(node.func)
+                if not target:
+                    continue
+                parts = target.split(".")
+                if (
+                    len(parts) == 2
+                    and parts[0] in ("self", "cls")
+                    and parts[1] in mod.attr_wrappers
+                ):
+                    impl = mod.attr_wrappers[parts[1]]
+                    self.call_edges[qual].add(impl)
+                    self.edges[qual].add(impl)
+
+    def _collect_attr_wrappers(self, mod: ModuleInfo) -> None:
+        ctx = self.files[mod.path]
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            fn_name = _dotted(node.value.func) or ""
+            if fn_name.split(".")[-1] not in {"jit", "pjit", "CachedStep"}:
+                continue
+            if not node.value.args:
+                continue
+            wrapped = _dotted(node.value.args[0])
+            if not wrapped:
+                continue
+            scope = self._enclosing_function(mod, node)
+            resolved = self.resolve(wrapped, mod, scope)
+            if not resolved:
+                continue
+            for tgt in node.targets:
+                tgt_name = _dotted(tgt)
+                if tgt_name and tgt_name.split(".")[0] in ("self", "cls"):
+                    mod.attr_wrappers[tgt_name.split(".")[-1]] = resolved
+
+    def _enclosing_function(
+        self, mod: ModuleInfo, node: ast.AST
+    ) -> Optional[FunctionInfo]:
+        del mod  # identity lookup; the map is project-wide
+        return self._enclosing.get(id(node))
+
+    # --------------------------------------------------------- jit entries
+
+    def _find_jit_entries(self) -> List[str]:
+        """Qualnames of functions traced by jit, project-wide.
+
+        Decorated (`@jax.jit`, `@partial(jax.jit, ...)`), wrapped
+        (`jax.jit(fn)` / `pjit(fn)` / `CachedStep(fn)` anywhere, with
+        `self._impl` and aliased references resolved), in every module.
+        """
+        entries: Set[str] = set()
+        for qual in sorted(self.functions):
+            info = self.functions[qual]
+            decorators = getattr(info.node, "decorator_list", [])
+            if any(
+                jit_decorator_kwargs(dec) is not None for dec in decorators
+            ):
+                entries.add(qual)
+        for path in sorted(self.files):
+            mod = self.modules[path]
+            ctx = self.files[path]
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = _dotted(node.func) or ""
+                if name.split(".")[-1] not in {"jit", "pjit", "CachedStep"}:
+                    continue
+                target = _dotted(node.args[0])
+                if not target:
+                    continue
+                scope = self._enclosing_function(mod, node)
+                resolved = self.resolve(target, mod, scope)
+                if resolved:
+                    entries.add(resolved)
+        return sorted(entries)
+
+    # ------------------------------------------------------------ queries
+
+    def function_at(self, node: ast.AST) -> Optional[FunctionInfo]:
+        qual = self.qualname_of_node.get(id(node))
+        return self.functions.get(qual) if qual else None
+
+    def functions_in(self, path: str) -> List[FunctionInfo]:
+        return [
+            self.functions[q]
+            for q in sorted(self.functions)
+            if self.functions[q].path == path
+        ]
+
+
+_dotted = dotted_name
+
+
+def _directly_nested(outer: ast.AST, inner: ast.AST) -> bool:
+    """True when `inner` is nested in `outer` with no def in between."""
+    for node in ast.iter_child_nodes(outer):
+        if node is inner:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _directly_nested(node, inner):
+            return True
+    return False
+
+
+def _scope_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of a function body, not descending into nested defs."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,108 @@
+"""Conservative forward dataflow over the jaxlint call graph.
+
+Two propagation primitives, both deterministic (sorted worklists, no
+hashing order dependence — the repo sweep must be byte-identical run
+to run):
+
+- `reach_with_chains(graph, roots)`: BFS from root functions recording
+  the first (shortest, lexicographically tie-broken) call chain to each
+  reachable function. Interprocedural rules attribute a finding deep in
+  a helper to the jit/step entry with the full chain in the message.
+- `closure_facts(graph, direct)`: the union of per-function boolean
+  facts over each function's transitive callee closure (fixed-point
+  over SCCs via iteration). Protocol rules use this to ask "does this
+  writer, or anything it calls, ever fsync?".
+
+Both operate on `CallGraph.edges` (calls + traced references) unless a
+rule passes `CallGraph.call_edges` explicitly.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.callgraph import CallGraph
+
+
+def reach_with_chains(
+    edges: Dict[str, Set[str]], roots: Sequence[str]
+) -> Dict[str, List[str]]:
+    """function qualname -> shortest call chain [root, ..., function].
+
+    Roots map to a one-element chain. Deterministic: BFS layer by layer,
+    neighbors visited in sorted order, first chain wins.
+    """
+    chains: Dict[str, List[str]] = {}
+    frontier = sorted(set(roots))
+    for root in frontier:
+        chains[root] = [root]
+    while frontier:
+        next_frontier: List[str] = []
+        for qual in frontier:
+            for callee in sorted(edges.get(qual, ())):
+                if callee in chains:
+                    continue
+                chains[callee] = chains[qual] + [callee]
+                next_frontier.append(callee)
+        frontier = sorted(set(next_frontier))
+    return chains
+
+
+def closure_facts(
+    edges: Dict[str, Set[str]], direct: Dict[str, Set[str]]
+) -> Dict[str, Set[str]]:
+    """function -> union of `direct` facts over it and its callees.
+
+    Handles cycles by iterating to a fixed point (facts only grow, so
+    termination is bounded by |functions| * |facts|).
+    """
+    facts: Dict[str, Set[str]] = {
+        qual: set(direct.get(qual, ())) for qual in edges
+    }
+    changed = True
+    while changed:
+        changed = False
+        for qual in sorted(edges):
+            merged = facts[qual]
+            before = len(merged)
+            for callee in edges[qual]:
+                if callee in facts:
+                    merged |= facts[callee]
+                else:
+                    merged |= set(direct.get(callee, ()))
+            if len(merged) != before:
+                changed = True
+    return facts
+
+
+def render_chain(graph: CallGraph, chain: Sequence[str]) -> str:
+    """`a.py::f -> b.py::C.g` rendered for a finding message."""
+    parts = []
+    for qual in chain:
+        info = graph.functions.get(qual)
+        parts.append(info.display if info else qual)
+    return " -> ".join(parts)
+
+
+def hot_functions(
+    graph: CallGraph, extra_roots: Iterable[str] = ()
+) -> Dict[str, List[str]]:
+    """Functions on a traced path: reachable from any jit entry.
+
+    Returns qualname -> chain from the owning jit entry. Host-helper
+    boundaries (logging/summary/checkpoint names) are NOT pruned here;
+    rules that need the exemption apply it themselves so each rule's
+    policy stays local to the rule.
+    """
+    roots = sorted(set(graph.jit_entries) | set(extra_roots))
+    return reach_with_chains(graph.edges, roots)
+
+
+def callers_of(edges: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    """Reverse edge map (callee -> callers)."""
+    rev: Dict[str, Set[str]] = collections.defaultdict(set)
+    for caller, callees in edges.items():
+        for callee in callees:
+            rev[callee].add(caller)
+    return dict(rev)

@@ -1,19 +1,30 @@
-"""jaxlint engine: findings, suppressions, baseline, file runner.
+"""jaxlint engine: findings, suppressions, baseline, project runner.
 
 A self-contained AST-level analyzer (stdlib only — it must never import
 the code under analysis, so it stays fast and side-effect free). Rules
-live in `tools.jaxlint.rules`; this module owns everything around them:
+live in `tools.jaxlint.rules` (file-local), `rules_perf`, and
+`rules_protocol` (interprocedural); this module owns everything around
+them:
 
 - `Finding`: one diagnostic, keyed for baseline matching by
   (path, rule, stripped source line) so line drift doesn't churn the
   baseline file.
+- `ProjectContext`: every linted file parsed once, plus the lazily
+  built whole-repo call graph (`tools.jaxlint.callgraph`) that
+  interprocedural rules share. Rules with `project = True` run once
+  per sweep via `check_project(project)`; classic rules run per file
+  via `check(ctx)`.
 - Inline suppressions: `# jaxlint: disable=JL001,JL005(reason)` on the
   flagged line or the line directly above silences those rules there;
   `# jaxlint: disable-file=JL006(reason)` anywhere in a file silences a
   rule for the whole file.
 - Baseline: a checked-in JSON of grandfathered findings; the gate fails
   only on findings NOT in the baseline (multiset semantics, so two
-  identical lines in one file need two entries).
+  identical lines in one file need two entries). `--update-baseline`
+  is the ratchet: it can shrink the baseline or re-key drifted entries,
+  never grow it silently.
+- Output: deterministic `text`, `json`, and `sarif` formats (two sweeps
+  over the same tree are byte-identical — timings go to stderr only).
 """
 
 from __future__ import annotations
@@ -26,6 +37,7 @@ import json
 import os
 import re
 import sys
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 _SUPPRESS_RE = re.compile(
@@ -84,6 +96,30 @@ class FileContext:
         )
 
 
+class ProjectContext:
+    """Every parsed file of one sweep plus the shared call graph."""
+
+    def __init__(self, files: Dict[str, FileContext], repo_root: str):
+        self.files = files
+        self.repo_root = repo_root
+        self._graph = None
+
+    @property
+    def graph(self):
+        if self._graph is None:
+            from tools.jaxlint.callgraph import CallGraph
+
+            self._graph = CallGraph(self.files)
+        return self._graph
+
+    def context_for(self, path: str) -> Optional[FileContext]:
+        return self.files.get(path)
+
+    def finding(self, path: str, node: ast.AST, rule, message: str) -> Finding:
+        ctx = self.files[path]
+        return ctx.finding(node, rule, message)
+
+
 def _suppressions(lines: Sequence[str]) -> Tuple[Dict[int, set], set]:
     """Returns ({line -> suppressed rule ids}, file-wide rule ids)."""
     per_line: Dict[int, set] = {}
@@ -117,32 +153,82 @@ def _is_suppressed(
     return False
 
 
-def lint_source(
-    path: str, source: str, rules: Sequence
-) -> Tuple[List[Finding], List[Finding]]:
-    """Lints one file's source; returns (active, suppressed) findings."""
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        finding = Finding(
-            path=path,
-            line=exc.lineno or 1,
-            col=exc.offset or 0,
-            rule="JL000",
-            message="file does not parse: %s" % exc.msg,
-        )
-        return [finding], []
-    ctx = FileContext(path, source, tree)
-    per_line, file_wide = _suppressions(ctx.lines)
+def _finding_sort_key(f: Finding) -> Tuple:
+    return (f.path, f.line, f.col, f.rule, f.message)
+
+
+def _run_rules(
+    project: ProjectContext, rules: Sequence
+) -> Tuple[List[Finding], List[Finding], Dict[str, float]]:
+    """Runs all rules over a project; returns (active, suppressed,
+    per-rule seconds). File rules run per file; project rules once."""
+    suppress_maps = {
+        path: _suppressions(ctx.lines)
+        for path, ctx in project.files.items()
+    }
     active: List[Finding] = []
     suppressed: List[Finding] = []
+    timings: Dict[str, float] = {}
     for rule in rules:
-        for finding in rule.check(ctx):
+        start = time.perf_counter()
+        raw: List[Finding] = []
+        if getattr(rule, "project", False):
+            raw = list(rule.check_project(project))
+        else:
+            for path in sorted(project.files):
+                raw.extend(rule.check(project.files[path]))
+        timings[rule.rule_id] = (
+            timings.get(rule.rule_id, 0.0) + time.perf_counter() - start
+        )
+        for finding in raw:
+            per_line, file_wide = suppress_maps.get(
+                finding.path, ({}, set())
+            )
             if _is_suppressed(finding, per_line, file_wide):
                 suppressed.append(finding)
             else:
                 active.append(finding)
-    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    active.sort(key=_finding_sort_key)
+    suppressed.sort(key=_finding_sort_key)
+    return active, suppressed, timings
+
+
+def build_project(
+    sources: Dict[str, str], repo_root: Optional[str] = None
+) -> Tuple[ProjectContext, List[Finding]]:
+    """Parses `path -> source` into a project; unparseable files become
+    JL000 findings and are excluded from the graph."""
+    files: Dict[str, FileContext] = {}
+    parse_findings: List[Finding] = []
+    for path in sorted(sources):
+        source = sources[path]
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            parse_findings.append(
+                Finding(
+                    path=path,
+                    line=exc.lineno or 1,
+                    col=exc.offset or 0,
+                    rule="JL000",
+                    message="file does not parse: %s" % exc.msg,
+                )
+            )
+            continue
+        files[path] = FileContext(path, source, tree)
+    return ProjectContext(files, repo_root or _REPO_ROOT), parse_findings
+
+
+def lint_source(
+    path: str, source: str, rules: Sequence
+) -> Tuple[List[Finding], List[Finding]]:
+    """Lints one file's source as a single-file project; returns
+    (active, suppressed) findings. Interprocedural rules see a project
+    containing only this file — their single-file behavior."""
+    project, parse_findings = build_project({path: source})
+    if parse_findings:
+        return parse_findings, []
+    active, suppressed, _ = _run_rules(project, rules)
     return active, suppressed
 
 
@@ -173,27 +259,27 @@ def run_paths(
     rules: Optional[Sequence] = None,
     baseline: Optional[Dict] = None,
 ) -> Dict:
-    """Lints `paths`; returns a result dict (see keys below).
+    """Lints `paths` as ONE project; returns a result dict.
 
     Result keys: `findings` (non-baselined, non-suppressed — these fail
     the gate), `baselined`, `suppressed`, `missing_paths`,
-    `unused_baseline` (stale entries worth pruning), `files` (count).
+    `unused_baseline` (stale entries worth pruning), `files` (count),
+    `timings` (rule id -> seconds, this run).
     """
     if rules is None:
         from tools.jaxlint.rules import ALL_RULES
 
         rules = ALL_RULES
     files, missing = iter_python_files(paths)
-    all_active: List[Finding] = []
-    all_suppressed: List[Finding] = []
+    sources: Dict[str, str] = {}
     for filename in files:
         with open(filename, "r", encoding="utf-8") as f:
-            source = f.read()
-        active, suppressed = lint_source(
-            _normalize(filename), source, rules
-        )
-        all_active.extend(active)
-        all_suppressed.extend(suppressed)
+            sources[_normalize(filename)] = f.read()
+    project, parse_findings = build_project(sources)
+    active, all_suppressed, timings = _run_rules(project, rules)
+    all_active = sorted(
+        parse_findings + active, key=_finding_sort_key
+    )
 
     budget = collections.Counter(
         (e["path"], e["rule"], e["code"]) for e in (baseline or {}).get(
@@ -220,7 +306,8 @@ def run_paths(
         "suppressed": all_suppressed,
         "missing_paths": missing,
         "unused_baseline": unused,
-        "files": len(files),
+        "files": len(sources),
+        "timings": timings,
     }
 
 
@@ -268,6 +355,132 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> Dict:
     return data
 
 
+def update_baseline(
+    baseline_path: str, result: Dict
+) -> Tuple[bool, List[str]]:
+    """The baseline RATCHET: shrink or re-key, never grow.
+
+    Given a `run_paths` result computed WITHOUT a baseline (every
+    active finding in `findings`), rewrites the baseline file to:
+
+    - keep entries still matched by a current finding,
+    - drop stale entries whose finding is gone (shrink),
+    - re-key entries whose source line drifted: within one
+      (path, rule) group, unmatched findings consume leftover old
+      entries one-for-one and take their place with the current code.
+
+    A finding with NO old entry to consume is growth; the update is
+    REFUSED (nothing written) and the offending findings are returned.
+    Returns (ok, messages).
+    """
+    old = load_baseline(baseline_path) or {"entries": []}
+    budget = collections.Counter(
+        (e["path"], e["rule"], e["code"]) for e in old["entries"]
+    )
+    matched: List[Finding] = []
+    unmatched: List[Finding] = []
+    for finding in result["findings"]:
+        key = finding.baseline_key()
+        if budget[key] > 0:
+            budget[key] -= 1
+            matched.append(finding)
+        else:
+            unmatched.append(finding)
+    # Leftover old entries per (path, rule) are the re-key budget.
+    leftovers = collections.Counter()
+    for (path, rule, _code), count in budget.items():
+        leftovers[(path, rule)] += count
+    rekeyed: List[Finding] = []
+    growth: List[Finding] = []
+    for finding in unmatched:
+        group = (finding.path, finding.rule)
+        if leftovers[group] > 0:
+            leftovers[group] -= 1
+            rekeyed.append(finding)
+        else:
+            growth.append(finding)
+    if growth:
+        return False, [
+            "refusing to grow the baseline (fix, suppress with a "
+            "reason, or use --write-baseline deliberately):"
+        ] + [f.render() for f in growth]
+    kept = sorted(matched + rekeyed, key=_finding_sort_key)
+    write_baseline(baseline_path, kept)
+    dropped = len(old["entries"]) - len(matched) - len(rekeyed)
+    return True, [
+        "baseline updated: %d kept, %d re-keyed, %d dropped"
+        % (len(matched), len(rekeyed), max(0, dropped))
+    ]
+
+
+def _as_json(result: Dict) -> str:
+    """Deterministic JSON: sorted findings, no timings/timestamps."""
+    return json.dumps(
+        {
+            "findings": [
+                dataclasses.asdict(f) for f in result["findings"]
+            ],
+            "baselined": len(result["baselined"]),
+            "suppressed": len(result["suppressed"]),
+            "files": result["files"],
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def _as_sarif(result: Dict, rules: Sequence) -> str:
+    """SARIF 2.1.0 (deterministic) for code-scanning UIs."""
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "jaxlint",
+                        "informationUri": "docs/jaxlint.md",
+                        "rules": [
+                            {
+                                "id": rule.rule_id,
+                                "shortDescription": {
+                                    "text": rule.summary
+                                },
+                            }
+                            for rule in sorted(
+                                rules, key=lambda r: r.rule_id
+                            )
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": f.line,
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in result["findings"]
+                ],
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="jaxlint",
@@ -294,7 +507,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="rewrite the baseline from the current findings and exit 0",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text"
+        "--update-baseline",
+        action="store_true",
+        help=(
+            "ratchet the baseline: prune fixed entries and re-key "
+            "drifted ones; refuses to add entries (exit 2)"
+        ),
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
+    parser.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-rule sweep timing to stderr",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
@@ -311,9 +537,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         parser.error("the following arguments are required: paths")
 
     baseline = None
-    if not args.no_baseline and not args.write_baseline:
+    if not (args.no_baseline or args.write_baseline or args.update_baseline):
         baseline = load_baseline(args.baseline)
     result = run_paths(args.paths, rules=ALL_RULES, baseline=baseline)
+
+    if args.timings:
+        total = 0.0
+        for rule_id in sorted(result["timings"]):
+            ms = result["timings"][rule_id] * 1000.0
+            total += ms
+            print(
+                "jaxlint: timing %s %.1f ms" % (rule_id, ms),
+                file=sys.stderr,
+            )
+        print(
+            "jaxlint: timing total %.1f ms over %d file(s)"
+            % (total, result["files"]),
+            file=sys.stderr,
+        )
 
     if args.write_baseline:
         write_baseline(args.baseline, result["findings"])
@@ -323,20 +564,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 0
 
+    if args.update_baseline:
+        ok, messages = update_baseline(args.baseline, result)
+        for message in messages:
+            print("jaxlint: %s" % message, file=sys.stderr)
+        return 0 if ok else 2
+
     if args.format == "json":
-        print(
-            json.dumps(
-                {
-                    "findings": [
-                        dataclasses.asdict(f) for f in result["findings"]
-                    ],
-                    "baselined": len(result["baselined"]),
-                    "suppressed": len(result["suppressed"]),
-                    "files": result["files"],
-                },
-                indent=2,
-            )
-        )
+        print(_as_json(result))
+    elif args.format == "sarif":
+        print(_as_sarif(result, ALL_RULES))
     else:
         for finding in result["findings"]:
             print(finding.render())

@@ -1,14 +1,22 @@
-"""The jaxlint rule set: 8 JAX/TPU-specific AST checks.
+"""The core jaxlint rule set: JL001-JL009.
 
 Every rule encodes an invariant this codebase has paid for at least once
 (see docs/jaxlint.md for the bad/good pair and the failure each rule
-prevents). The analysis is intentionally file-local and approximate —
-"jitted" means a `jax.jit`/`pjit` decorator, a `jax.jit(fn)` wrap, or a
-function handed to `CachedStep` (this repo's signature-cached jit
-wrapper); the call graph used for hot-path reachability is intra-file.
+prevents). Since PR 11 the analysis is interprocedural: rules that need
+reachability (JL002/JL004/JL005/JL009) run over the whole-repo call
+graph (`tools.jaxlint.callgraph` — imports, `self.`/class methods, and
+traced function references all resolve), so a host sync buried two
+helper calls below a jitted step is attributed to the jit entry with the
+full call chain in the message. "Jitted" means a `jax.jit`/`pjit`
+decorator, a `jax.jit(fn)` wrap, or a function handed to `CachedStep`
+(this repo's signature-cached jit wrapper) — in ANY file of the sweep.
 False positives are expected to be rare and are handled with inline
 `# jaxlint: disable=JLxxx(reason)` suppressions or the baseline file,
 never by weakening the rule.
+
+The perf pack (JL010-JL012) lives in `rules_perf.py`, the protocol pack
+(JL013-JL015) in `rules_protocol.py`; `ALL_RULES` below aggregates all
+three.
 """
 
 from __future__ import annotations
@@ -17,50 +25,14 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
-from tools.jaxlint.engine import FileContext, Finding
+from tools.jaxlint.callgraph import (
+    dotted_name,
+    is_jit_expr as _is_jit_expr,
+    jit_decorator_kwargs,
+)
+from tools.jaxlint.engine import FileContext, Finding, ProjectContext
 
 # --------------------------------------------------------------- helpers
-
-
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """`a.b.c` for Attribute/Name chains, else None."""
-    if isinstance(node, ast.Attribute):
-        base = dotted_name(node.value)
-        return "%s.%s" % (base, node.attr) if base else None
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-def _is_jit_expr(node: ast.AST) -> bool:
-    """True for an expression naming a jit-family transform."""
-    name = dotted_name(node)
-    if not name:
-        return False
-    return name.split(".")[-1] in {"jit", "pjit"}
-
-
-def jit_decorator_kwargs(dec: ast.AST) -> Optional[Set[str]]:
-    """If `dec` is a jit-family decorator, the keyword names it passes.
-
-    Handles `@jax.jit`, `@jit`, `@pjit`, `@jax.jit(...)`, and
-    `@functools.partial(jax.jit, ...)`. Returns None for non-jit
-    decorators.
-    """
-    if _is_jit_expr(dec):
-        return set()
-    if isinstance(dec, ast.Call):
-        if _is_jit_expr(dec.func):
-            return {kw.arg for kw in dec.keywords if kw.arg}
-        func = dotted_name(dec.func)
-        if (
-            func
-            and func.split(".")[-1] == "partial"
-            and dec.args
-            and _is_jit_expr(dec.args[0])
-        ):
-            return {kw.arg for kw in dec.keywords if kw.arg}
-    return None
 
 
 def iter_functions(
@@ -131,6 +103,21 @@ def param_names(func: ast.FunctionDef) -> List[str]:
     return [n for n in names if n not in ("self", "cls")]
 
 
+def _param_defaults(func: ast.AST) -> Dict[str, ast.AST]:
+    """param name -> default expression, for params that have one."""
+    args = func.args
+    out: Dict[str, ast.AST] = {}
+    positional = list(args.posonlyargs) + list(args.args)
+    for arg, default in zip(
+        positional[len(positional) - len(args.defaults):], args.defaults
+    ):
+        out[arg.arg] = default
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            out[arg.arg] = default
+    return out
+
+
 def assigned_names(node: ast.AST) -> Set[str]:
     """Names bound by assignments/loops/withs anywhere under `node`."""
     out: Set[str] = set()
@@ -145,17 +132,25 @@ def assigned_names(node: ast.AST) -> Set[str]:
 
 
 def local_call_graph(ctx: FileContext) -> Dict[str, Set[str]]:
-    """name -> names it calls (plain `f(...)` and `self.f(...)`)."""
-    graph: Dict[str, Set[str]] = {}
-    for func in iter_functions(ctx.tree):
-        callees: Set[str] = set()
-        for node in ast.walk(func):
-            if isinstance(node, ast.Call):
-                name = dotted_name(node.func)
-                if name:
-                    callees.add(name.split(".")[-1])
-        graph.setdefault(func.name, set()).update(callees)
-    return graph
+    """name -> names it calls, resolved through the real call graph.
+
+    PR-1's version matched bare last components, so `self.method()`
+    resolved to ANY same-named function and `ckpt.write(...)` (aliased
+    import) resolved to a local `write` — both silently wrong. This now
+    builds a single-file `CallGraph` (proper `self.`/class-method and
+    import-alias resolution) and projects edges back to bare names for
+    the callers that still want the old shape.
+    """
+    from tools.jaxlint.callgraph import CallGraph
+
+    graph = CallGraph({ctx.path: ctx})
+    out: Dict[str, Set[str]] = {}
+    for qual, callees in graph.edges.items():
+        name = qual.split("::", 1)[1].split(".")[-1]
+        out.setdefault(name, set()).update(
+            c.split("::", 1)[1].split(".")[-1] for c in callees
+        )
+    return out
 
 
 def reachable_from(
@@ -175,8 +170,14 @@ def reachable_from(
 class Rule:
     rule_id = "JL000"
     summary = ""
+    #: Project rules run once per sweep over the whole-repo call graph
+    #: (`check_project`); file rules run per file (`check`).
+    project = False
 
     def check(self, ctx: FileContext) -> List[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
         raise NotImplementedError
 
 
@@ -265,16 +266,20 @@ class TracerLeakRule(Rule):
 
 
 class HostSyncRule(Rule):
-    """Host-device syncs in jit-traced code or functions it calls.
+    """Host-device syncs reachable from jit-traced code, repo-wide.
 
     `.item()`, `float()`, `np.asarray`, `jax.device_get`,
     `block_until_ready` inside traced code either fail on tracers or
     force a blocking device round-trip on the hot path — paid once per
-    candidate per boosting iteration in this codebase.
+    candidate per boosting iteration in this codebase. Interprocedural:
+    a sync three frames below the jit entry — through `self.` methods,
+    aliased imports, or a `lax.scan` body reference — is found and
+    attributed to the entry with the full call chain.
     """
 
     rule_id = "JL002"
     summary = "host-device sync on a jit-traced hot path"
+    project = True
 
     _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
     _SYNC_CALLS = {
@@ -289,23 +294,45 @@ class HostSyncRule(Rule):
     }
     _CASTS = {"float", "int", "bool"}
 
-    def check(self, ctx: FileContext) -> List[Finding]:
-        jitted = jit_functions(ctx)
-        if not jitted:
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+
+        graph = proj.graph
+        if not graph.jit_entries:
             return []
-        graph = local_call_graph(ctx)
-        jit_names = {f.name for f in jitted}
-        hot = reachable_from(sorted(jit_names), graph)
-        hot_funcs = [
-            f
-            for f in iter_functions(ctx.tree)
-            if f.name in hot and not self._host_helper(f)
+        # Host-helper boundary: traversal never enters a helper whose
+        # name declares it host-side, so nothing reached only through
+        # one is "hot".
+        pruned = {
+            qual: {
+                c
+                for c in callees
+                if not self._host_helper_name(_short_name(c))
+            }
+            for qual, callees in graph.edges.items()
+        }
+        roots = [
+            q
+            for q in graph.jit_entries
+            if not self._host_helper_name(_short_name(q))
         ]
-        findings = []
-        for func in hot_funcs:
-            in_jit = func.name in jit_names
-            params = set(param_names(func))
-            for node in ast.walk(func):
+        chains = dataflow.reach_with_chains(pruned, roots)
+        findings: List[Finding] = []
+        for qual in sorted(chains):
+            info = graph.functions.get(qual)
+            if info is None:
+                continue
+            ctx = proj.files[info.path]
+            chain = chains[qual]
+            via = (
+                " [call chain: %s]" % dataflow.render_chain(graph, chain)
+                if len(chain) > 1
+                else ""
+            )
+            params = set(param_names(info.node)) if not isinstance(
+                info.node, ast.Lambda
+            ) else set()
+            for node in _scope_walk(info.node):
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func) or ""
@@ -317,9 +344,14 @@ class HostSyncRule(Rule):
                         ctx.finding(
                             node,
                             self.rule_id,
-                            ".%s() in %r (reached from a jitted step) "
-                            "blocks on the device"
-                            % (node.func.attr, func.name),
+                            ".%s() in %r (reached from jitted %r) blocks "
+                            "on the device%s"
+                            % (
+                                node.func.attr,
+                                info.name,
+                                _short_name(chain[0]),
+                                via,
+                            ),
                         )
                     )
                 elif name in self._SYNC_CALLS:
@@ -327,13 +359,16 @@ class HostSyncRule(Rule):
                         ctx.finding(
                             node,
                             self.rule_id,
-                            "%s in %r (reached from a jitted step) pulls "
-                            "the value to the host" % (name, func.name),
+                            "%s in %r (reached from jitted %r) pulls the "
+                            "value to the host%s"
+                            % (name, info.name, _short_name(chain[0]), via),
                         )
                     )
                 elif (
-                    in_jit
-                    and name in self._CASTS
+                    # Casts of an own parameter concretize anywhere on a
+                    # traced path — in the jit entry itself or any
+                    # function it (transitively) reaches.
+                    name in self._CASTS
                     and len(node.args) == 1
                     and isinstance(node.args[0], ast.Name)
                     and node.args[0].id in params
@@ -342,14 +377,21 @@ class HostSyncRule(Rule):
                         ctx.finding(
                             node,
                             self.rule_id,
-                            "%s(%s) inside jitted %r concretizes a tracer"
-                            % (name, node.args[0].id, func.name),
+                            "%s(%s) in %r (traced under jitted %r) "
+                            "concretizes a tracer%s"
+                            % (
+                                name,
+                                node.args[0].id,
+                                info.name,
+                                _short_name(chain[0]),
+                                via,
+                            ),
                         )
                     )
         return findings
 
     @staticmethod
-    def _host_helper(func: ast.FunctionDef) -> bool:
+    def _host_helper_name(name: str) -> bool:
         # Logging/summary/checkpoint helpers are host-side by design even
         # when a jitted method's class also defines them.
         # "log" needs word-ish boundaries: a bare substring match would
@@ -357,9 +399,14 @@ class HostSyncRule(Rule):
         return bool(
             re.search(
                 r"summar|(?:^|_)log(?:$|_|ging)|checkpoint|save|restore|host",
-                func.name,
+                name,
             )
         )
+
+
+def _short_name(qualname: str) -> str:
+    """`path::Class.method` -> `method`; `path::f.<locals>.g` -> `g`."""
+    return qualname.split("::", 1)[-1].split(".")[-1]
 
 
 # ---------------------------------------------------------------- JL003
@@ -470,6 +517,7 @@ class MissingDonationRule(Rule):
 
     rule_id = "JL004"
     summary = "jitted step function without donate_argnums"
+    project = True
 
     _STEP_NAME = re.compile(r"step|update|train")
     _SKIP_NAME = re.compile(
@@ -485,41 +533,101 @@ class MissingDonationRule(Rule):
         "model_state",
     }
 
-    def check(self, ctx: FileContext) -> List[Finding]:
-        findings = []
-        for func in iter_functions(ctx.tree):
-            kwargs: Optional[Set[str]] = None
-            for dec in func.decorator_list:
-                info = jit_decorator_kwargs(dec)
-                if info is not None:
-                    kwargs = info
-                    break
-            if kwargs is None:
-                continue
-            if not self._STEP_NAME.search(func.name):
-                continue
-            if self._SKIP_NAME.search(func.name):
-                continue
-            state_args = [
-                n
-                for n in param_names(func)
-                if n in self._STATE_PARAMS
-                or n.endswith("_state")
-                or n.endswith("_params")
-            ]
-            if not state_args:
-                continue
-            if kwargs & {"donate_argnums", "donate_argnames"}:
-                continue
-            findings.append(
-                ctx.finding(
-                    func,
-                    self.rule_id,
-                    "jitted step %r carries state (%s) without "
-                    "donate_argnums: peak memory holds input AND output "
-                    "buffers" % (func.name, ", ".join(state_args)),
+    def _state_args(self, func) -> List[str]:
+        return [
+            n
+            for n in param_names(func)
+            if n in self._STATE_PARAMS
+            or n.endswith("_state")
+            or n.endswith("_params")
+        ]
+
+    def _step_like(self, name: str) -> bool:
+        return bool(
+            self._STEP_NAME.search(name)
+            and not self._SKIP_NAME.search(name)
+        )
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in sorted(proj.files):
+            ctx = proj.files[path]
+            for func in iter_functions(ctx.tree):
+                kwargs: Optional[Set[str]] = None
+                for dec in func.decorator_list:
+                    info = jit_decorator_kwargs(dec)
+                    if info is not None:
+                        kwargs = info
+                        break
+                if kwargs is None:
+                    continue
+                if not self._step_like(func.name):
+                    continue
+                state_args = self._state_args(func)
+                if not state_args:
+                    continue
+                if kwargs & {"donate_argnums", "donate_argnames"}:
+                    continue
+                findings.append(
+                    ctx.finding(
+                        func,
+                        self.rule_id,
+                        "jitted step %r carries state (%s) without "
+                        "donate_argnums: peak memory holds input AND "
+                        "output buffers" % (func.name, ", ".join(state_args)),
+                    )
                 )
-            )
+        findings.extend(self._check_wraps(proj))
+        return findings
+
+    def _check_wraps(self, proj: ProjectContext) -> List[Finding]:
+        """`jax.jit(fn)` / `CachedStep(self._impl)` wrap sites: the
+        donation contract lives at the wrap, and the wrapped function
+        can be a `self.` method or an aliased import — resolved through
+        the project graph."""
+        graph = proj.graph
+        findings: List[Finding] = []
+        for path in sorted(proj.files):
+            ctx = proj.files[path]
+            mod = graph.modules.get(path)
+            if mod is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = dotted_name(node.func) or ""
+                if name.split(".")[-1] not in {"jit", "pjit", "CachedStep"}:
+                    continue
+                given = {kw.arg for kw in node.keywords if kw.arg}
+                if given & {"donate_argnums", "donate_argnames"}:
+                    continue
+                target = dotted_name(node.args[0])
+                if not target:
+                    continue
+                scope = graph._enclosing_function(mod, node)
+                resolved = graph.resolve(target, mod, scope)
+                if resolved is None:
+                    continue
+                info = graph.functions[resolved]
+                if not self._step_like(info.name):
+                    continue
+                state_args = self._state_args(info.node)
+                if not state_args:
+                    continue
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "%s wrap of step %r carries state (%s) without "
+                        "donate_argnums: peak memory holds input AND "
+                        "output buffers"
+                        % (
+                            name.split(".")[-1],
+                            info.name,
+                            ", ".join(state_args),
+                        ),
+                    )
+                )
         return findings
 
 
@@ -537,16 +645,78 @@ class KeyReuseRule(Rule):
 
     rule_id = "JL005"
     summary = "PRNG key reused by two jax.random draws without a split"
+    project = True
 
     _DERIVE = {"split", "fold_in", "PRNGKey", "key", "clone", "wrap_key_data"}
 
-    def check(self, ctx: FileContext) -> List[Finding]:
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        graph = proj.graph
+        self._consuming = self._consuming_params(graph)
+        self._graph = graph
         findings = []
-        for func in iter_functions(ctx.tree):
-            findings.extend(self._check_scope(ctx, func))
+        for path in sorted(proj.files):
+            ctx = proj.files[path]
+            for func in iter_functions(ctx.tree):
+                findings.extend(self._check_scope(ctx, func))
         return findings
 
     # -- helpers
+
+    def _consuming_params(self, graph) -> Dict[str, Set[int]]:
+        """qualname -> indices of params the function draws from.
+
+        Transitive to a fixed point: a param forwarded into a consuming
+        param of a resolved callee is itself consuming — so
+        `self._draw(key)` counts as a draw from `key` at the call site,
+        however deep the actual `jax.random.*` call is buried.
+        """
+        consuming: Dict[str, Set[int]] = {
+            q: set() for q in graph.functions
+        }
+        changed = True
+        while changed:
+            changed = False
+            for qual in sorted(graph.functions):
+                info = graph.functions[qual]
+                if isinstance(info.node, ast.Lambda):
+                    continue
+                params = param_names(info.node)
+                index = {n: i for i, n in enumerate(params)}
+                mod = graph.modules[info.path]
+                for node in _scope_walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    hits: List[int] = []
+                    if self._is_random_consumer(node) and node.args:
+                        first = node.args[0]
+                        if (
+                            isinstance(first, ast.Name)
+                            and first.id in index
+                        ):
+                            hits.append(index[first.id])
+                    else:
+                        target = dotted_name(node.func)
+                        resolved = (
+                            graph.resolve(target, mod, info)
+                            if target
+                            else None
+                        )
+                        if resolved is not None:
+                            callee_consumes = consuming.get(
+                                resolved, set()
+                            )
+                            for pos, arg in enumerate(node.args):
+                                if (
+                                    pos in callee_consumes
+                                    and isinstance(arg, ast.Name)
+                                    and arg.id in index
+                                ):
+                                    hits.append(index[arg.id])
+                    for hit in hits:
+                        if hit not in consuming[qual]:
+                            consuming[qual].add(hit)
+                            changed = True
+        return consuming
 
     def _is_random_consumer(self, call: ast.Call) -> bool:
         name = dotted_name(call.func)
@@ -558,11 +728,36 @@ class KeyReuseRule(Rule):
         # jax.random.normal / random.bernoulli / jrandom.uniform ...
         return "random" in parts[:-1]
 
-    def _consumed_key(self, call: ast.Call) -> Optional[str]:
-        if not self._is_random_consumer(call) or not call.args:
+    def _consumed_key(
+        self, call: ast.Call, ctx: FileContext, scope
+    ) -> Optional[str]:
+        """The key NAME this call consumes, or None.
+
+        Direct (`jax.random.normal(key, ...)`) or transitive through a
+        resolved project function whose summary says the matching param
+        position is consuming (`self._draw(key)`).
+        """
+        if self._is_random_consumer(call):
+            if not call.args:
+                return None
+            first = call.args[0]
+            return first.id if isinstance(first, ast.Name) else None
+        graph = getattr(self, "_graph", None)
+        if graph is None:
             return None
-        first = call.args[0]
-        return first.id if isinstance(first, ast.Name) else None
+        mod = graph.modules.get(ctx.path)
+        if mod is None:
+            return None
+        target = dotted_name(call.func)
+        resolved = graph.resolve(target, mod, scope) if target else None
+        if resolved is None:
+            return None
+        for pos in sorted(self._consuming.get(resolved, ())):
+            if pos < len(call.args) and isinstance(
+                call.args[pos], ast.Name
+            ):
+                return call.args[pos].id
+        return None
 
     def _check_scope(
         self, ctx: FileContext, func: ast.FunctionDef
@@ -582,9 +777,13 @@ class KeyReuseRule(Rule):
         findings: List[Finding] = []
         draws: List[Tuple[int, str, ast.Call]] = []
         stores: Dict[str, List[int]] = {}
+        scope = None
+        graph = getattr(self, "_graph", None)
+        if graph is not None:
+            scope = graph.function_at(func)
         for node in _scope_walk(func):
             if isinstance(node, ast.Call):
-                key = self._consumed_key(node)
+                key = self._consumed_key(node, ctx, scope)
                 if key is not None:
                     draws.append((node.lineno, key, node))
             elif isinstance(node, ast.Name) and isinstance(
@@ -618,7 +817,7 @@ class KeyReuseRule(Rule):
             for node in ast.walk(loop):
                 if not isinstance(node, ast.Call) or id(node) in flagged:
                     continue
-                key = self._consumed_key(node)
+                key = self._consumed_key(node, ctx, scope)
                 if key is not None and key not in rebound:
                     flagged.add(id(node))
                     findings.append(
@@ -874,6 +1073,7 @@ class UnboundedWaitRule(Rule):
 
     rule_id = "JL009"
     summary = "unbounded KV-store/coordination wait (no timeout/deadline)"
+    project = True
 
     _TIMEOUT_KWARGS = {
         "timeout",
@@ -898,7 +1098,14 @@ class UnboundedWaitRule(Rule):
         "wait_for_ref": 3,
     }
 
-    def check(self, ctx: FileContext) -> List[Finding]:
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in sorted(proj.files):
+            findings.extend(self._check_sites(proj.files[path]))
+        findings.extend(self._check_wrappers(proj))
+        return findings
+
+    def _check_sites(self, ctx: FileContext) -> List[Finding]:
         findings = []
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call) or not isinstance(
@@ -933,6 +1140,81 @@ class UnboundedWaitRule(Rule):
             )
         return findings
 
+    def _check_wrappers(self, proj: ProjectContext) -> List[Finding]:
+        """Transitive: a wrapper whose wait is bounded ONLY by its own
+        `timeout=None`-defaulted parameter is unbounded at every call
+        site that omits the timeout — flag those call sites."""
+        graph = proj.graph
+        conditional: Dict[str, str] = {}  # qualname -> timeout param name
+        for qual in sorted(graph.functions):
+            info = graph.functions[qual]
+            node = info.node
+            if isinstance(node, ast.Lambda):
+                continue
+            defaults = _param_defaults(node)
+            none_timeouts = {
+                name
+                for name, default in defaults.items()
+                if name in self._TIMEOUT_KWARGS
+                and isinstance(default, ast.Constant)
+                and default.value is None
+            }
+            if not none_timeouts:
+                continue
+            for sub in _scope_walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in self._BOUNDED_AT
+                ):
+                    for kw in sub.keywords:
+                        if (
+                            kw.arg in self._TIMEOUT_KWARGS
+                            and isinstance(kw.value, ast.Name)
+                            and kw.value.id in none_timeouts
+                        ):
+                            conditional[qual] = kw.value.id
+        if not conditional:
+            return []
+        findings: List[Finding] = []
+        for qual in sorted(graph.functions):
+            info = graph.functions[qual]
+            mod = graph.modules[info.path]
+            ctx = proj.files[info.path]
+            for node in _scope_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = dotted_name(node.func)
+                resolved = (
+                    graph.resolve(target, mod, info) if target else None
+                )
+                if resolved not in conditional:
+                    continue
+                timeout_param = conditional[resolved]
+                given = {kw.arg for kw in node.keywords if kw.arg}
+                if given & self._TIMEOUT_KWARGS:
+                    continue
+                callee = graph.functions[resolved]
+                positions = {
+                    n: i for i, n in enumerate(param_names(callee.node))
+                }
+                if len(node.args) > positions.get(
+                    timeout_param, len(node.args)
+                ):
+                    continue  # timeout passed positionally
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "call to %r leaves its %r=None default in "
+                        "place: the wait inside it is unbounded — pass "
+                        "a deadline (a lost peer should cost one "
+                        "timeout, not a hang)"
+                        % (_short_name(resolved), timeout_param),
+                    )
+                )
+        return findings
+
     @staticmethod
     def _non_blocking_receiver(node: ast.Call) -> bool:
         """Receivers whose `.wait()`/`.join()` cannot hang on a peer.
@@ -948,7 +1230,7 @@ class UnboundedWaitRule(Rule):
         )
 
 
-ALL_RULES: List[Rule] = [
+CORE_RULES: List[Rule] = [
     TracerLeakRule(),
     HostSyncRule(),
     RecompileHazardRule(),
@@ -959,5 +1241,17 @@ ALL_RULES: List[Rule] = [
     TracerBranchRule(),
     UnboundedWaitRule(),
 ]
+
+
+def _all_rules() -> List[Rule]:
+    # The packs import from this module; aggregate lazily to keep the
+    # import graph acyclic (rules_perf/rules_protocol -> rules).
+    from tools.jaxlint.rules_perf import PERF_RULES
+    from tools.jaxlint.rules_protocol import PROTOCOL_RULES
+
+    return CORE_RULES + PERF_RULES + PROTOCOL_RULES
+
+
+ALL_RULES: List[Rule] = _all_rules()
 
 RULES_BY_ID: Dict[str, Rule] = {r.rule_id: r for r in ALL_RULES}

@@ -1,0 +1,624 @@
+"""The jaxlint protocol pack: JL013-JL015, crash-safety invariants.
+
+PRs 5-8 built runtime protocols — staged+fsync+rename atomic writes,
+set-once refs, TTL leases, lock discipline, armed fault sites — that
+only chaos tests exercise. These rules make the invariants cheap to
+verify on every commit: a torn-write bug is caught at review time as a
+non-atomic `open(..., "w")`, a deadlock as a lock-order inversion, a
+chaos blind spot as a fault site no test arms. All interprocedural
+over `tools.jaxlint.callgraph` where it matters (a writer that
+delegates to `_atomic_write_bytes` is atomic by delegation).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.callgraph import dotted_name
+from tools.jaxlint.engine import FileContext, Finding, ProjectContext
+from tools.jaxlint.rules import Rule, _scope_walk, _short_name
+
+# ---------------------------------------------------------------- JL013
+
+
+class NonAtomicWriteRule(Rule):
+    """Persistence writes outside the staged+fsync+rename idiom.
+
+    In the persistence modules (`store/`, `core/checkpoint.py`,
+    `serving/publisher.py`) every byte that lands at a final path must
+    arrive via stage (tempfile in a staging dir) + fsync + atomic
+    rename/link, or a reader can observe a torn file after a crash —
+    the exact failure `ADANET_FAULTS=...:torn` injects. A bare
+    `open(path, "w")` or an `os.replace` in a function whose transitive
+    closure never stages or fsyncs is a protocol escape. Delegation
+    counts: a writer that calls `_atomic_write_bytes` (or any helper
+    that stages+fsyncs+renames) satisfies the idiom.
+    """
+
+    rule_id = "JL013"
+    summary = "non-atomic persistence write (missing stage+fsync+rename)"
+    project = True
+
+    _SCOPED_SUFFIXES = ("/core/checkpoint.py", "/serving/publisher.py")
+    _SCOPED_DIRS = ("/store/",)
+
+    _STAGING = {"mkstemp", "mkdtemp", "NamedTemporaryFile", "TemporaryDirectory"}
+    _RENAME = {"replace", "rename", "link"}
+
+    def _in_scope(self, path: str) -> bool:
+        # The leading "/" anchors the suffixes at a path-component
+        # boundary (an unrelated `xcore/checkpoint.py` must not match).
+        slashed = "/" + path.replace("\\", "/")
+        return slashed.endswith(self._SCOPED_SUFFIXES) or any(
+            d in slashed for d in self._SCOPED_DIRS
+        )
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+
+        scoped = [p for p in sorted(proj.files) if self._in_scope(p)]
+        if not scoped:
+            return []
+        graph = proj.graph
+        # Per-function direct facts, then transitive closure so a write
+        # path that delegates staging/fsync to a helper is recognized.
+        # Closure runs over CALL edges only: a reference edge (passing a
+        # helper as a callback argument) must not credit the writer with
+        # staging it never performs.
+        direct: Dict[str, Set[str]] = {}
+        for qual in graph.functions:
+            facts: Set[str] = set()
+            info = graph.functions[qual]
+            for node in _scope_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                last = name.split(".")[-1]
+                if last in self._STAGING:
+                    facts.add("stage")
+                elif last == "fsync":
+                    facts.add("fsync")
+                elif last in self._RENAME and name.startswith("os."):
+                    facts.add("rename")
+            direct[qual] = facts
+        closure = dataflow.closure_facts(graph.call_edges, direct)
+        callers = dataflow.callers_of(graph.call_edges)
+
+        findings: List[Finding] = []
+        for path in scoped:
+            ctx = proj.files[path]
+            for info in graph.functions_in(path):
+                facts = closure.get(info.qualname, set())
+                chain = self._entry_chain(graph, callers, info.qualname)
+                via = (
+                    " [reached via %s]"
+                    % dataflow.render_chain(graph, chain)
+                    if len(chain) > 1
+                    else ""
+                )
+                missing = sorted(
+                    {"stage", "fsync", "rename"} - facts
+                )
+                for node in _scope_walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    write = self._write_call(node)
+                    if write is None:
+                        continue
+                    kind, detail = write
+                    if kind == "open" and not missing:
+                        continue  # full idiom present in the closure
+                    if kind == "rename" and (
+                        "stage" in facts and "fsync" in facts
+                    ):
+                        continue  # rename of a staged+fsynced payload
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "%s in %r escapes the staged+fsync+rename "
+                            "protocol (closure is missing: %s) — a "
+                            "crash here leaves a torn file a reader "
+                            "can observe; route it through the atomic "
+                            "writer%s"
+                            % (
+                                detail,
+                                info.name,
+                                ", ".join(missing) or "nothing, but "
+                                "the write bypasses the staged path",
+                                via,
+                            ),
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _entry_chain(graph, callers, qualname: str) -> List[str]:
+        """[entry, ..., qualname]: the (deterministic) caller chain up
+        to a function nobody calls — how reviewers reach the write."""
+        chain = [qualname]
+        seen = {qualname}
+        cur = qualname
+        while True:
+            ups = sorted(c for c in callers.get(cur, ()) if c not in seen)
+            if not ups:
+                return chain
+            cur = ups[0]
+            seen.add(cur)
+            chain.insert(0, cur)
+
+    def _write_call(
+        self, node: ast.Call
+    ) -> Optional[Tuple[str, str]]:
+        name = dotted_name(node.func) or ""
+        if name == "open" or name.endswith(".open"):
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and any(c in mode.value for c in "wax+")
+            ):
+                return "open", "open(..., %r)" % mode.value
+            return None
+        last = name.split(".")[-1]
+        if name.startswith("os.") and last in self._RENAME:
+            return "rename", name
+        return None
+
+
+# ---------------------------------------------------------------- JL014
+
+
+class LockOrderRule(Rule):
+    """Lock-order inversions across the threaded modules.
+
+    Two locks taken in opposite orders on two code paths deadlock under
+    the right interleaving — the serving plane (`model_pool` flip lock,
+    frontend condition) and the elastic scheduler both hold locks while
+    calling into other lock-taking components. The rule builds a
+    lock-order graph (edge L1->L2 when L2 is acquired — directly or via
+    any resolved callee — while L1 is held) and reports every edge that
+    participates in a cycle. Lock identity is the defining site:
+    `path::Class.attr` for `self._lock`-style locks, `path::name` for
+    module-level locks; function-local locks can't cross-thread and are
+    ignored.
+    """
+
+    rule_id = "JL014"
+    summary = "lock-order inversion (potential deadlock cycle)"
+    project = True
+
+    _FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+
+        graph = proj.graph
+        locks, kinds = self._find_locks(proj, graph)
+        if not locks:
+            return []
+        self._kinds = kinds
+        # Direct acquisitions per function.
+        direct: Dict[str, Set[str]] = {}
+        for qual in graph.functions:
+            info = graph.functions[qual]
+            acquired: Set[str] = set()
+            for node in _scope_walk(info.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lock = self._lock_of(
+                            item.context_expr, info, locks
+                        )
+                        if lock:
+                            acquired.add(lock)
+            direct[qual] = acquired
+        closure = dataflow.closure_facts(graph.call_edges, direct)
+
+        # Order edges: L1 -> L2 with a witness (path, node, describe).
+        edges: Dict[Tuple[str, str], Tuple[str, ast.AST, str]] = {}
+        for qual in sorted(graph.functions):
+            info = graph.functions[qual]
+            mod = graph.modules[info.path]
+            self._collect_edges(
+                info.node, info, mod, graph, locks, closure, edges, held=[]
+            )
+
+        # Cycle detection: an edge is reported when its endpoints are
+        # mutually reachable in the order graph. A self-edge only exists
+        # for NON-reentrant locks (filtered at collection) and is an
+        # immediate deadlock, not an ordering problem.
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        findings: List[Finding] = []
+        for (a, b) in sorted(edges):
+            path, node, describe = edges[(a, b)]
+            ctx = proj.files[path]
+            if a == b:
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "re-acquiring non-reentrant lock %s while "
+                        "already holding it (%s) deadlocks immediately "
+                        "— use an RLock or restructure"
+                        % (_lock_short(a), describe),
+                    )
+                )
+            elif self._reaches(adj, b, a):
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "lock-order inversion: %s is acquired while "
+                        "holding %s here, but the opposite order also "
+                        "exists (%s) — pick one global order or drop "
+                        "to a single lock"
+                        % (
+                            _lock_short(b),
+                            _lock_short(a),
+                            describe,
+                        ),
+                    )
+                )
+        return findings
+
+    def _find_locks(
+        self, proj, graph
+    ) -> Tuple[
+        Dict[Tuple[str, Optional[str], str], str], Dict[str, str]
+    ]:
+        """((path, class-or-None, attr/name) -> lock id, id -> factory).
+
+        Keyed by the OWNING class so two classes in one file each
+        defining `self._lock` stay two distinct locks — merging them
+        would fabricate order edges between unrelated components. The
+        factory kind distinguishes reentrant locks (RLock/Condition —
+        safe to re-acquire) from plain Locks (self-deadlock).
+        """
+        locks: Dict[Tuple[str, Optional[str], str], str] = {}
+        kinds: Dict[str, str] = {}
+        for path in sorted(proj.files):
+            ctx = proj.files[path]
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call
+                ):
+                    continue
+                factory = dotted_name(node.value.func) or ""
+                if factory.split(".")[-1] not in self._FACTORIES:
+                    continue
+                for tgt in node.targets:
+                    tname = dotted_name(tgt)
+                    if not tname:
+                        continue
+                    if tname.startswith("self."):
+                        attr = tname.split(".", 1)[1]
+                        if "." in attr:
+                            continue
+                        cls = self._owning_class(graph, path, node)
+                        lock_id = "%s::%s.%s" % (path, cls or "?", attr)
+                        locks[(path, cls, attr)] = lock_id
+                        kinds[lock_id] = factory.split(".")[-1]
+                    elif "." not in tname and self._is_module_level(
+                        ctx.tree, node
+                    ):
+                        lock_id = "%s::%s" % (path, tname)
+                        locks[(path, None, tname)] = lock_id
+                        kinds[lock_id] = factory.split(".")[-1]
+        return locks, kinds
+
+    @staticmethod
+    def _is_module_level(tree: ast.Module, node: ast.AST) -> bool:
+        return node in tree.body
+
+    @staticmethod
+    def _owning_class(graph, path, node) -> Optional[str]:
+        mod = graph.modules.get(path)
+        if mod is None:
+            return None
+        scope = graph._enclosing_function(mod, node)
+        return scope.class_name if scope else None
+
+    def _lock_of(
+        self,
+        expr: ast.AST,
+        info,
+        locks: Dict[Tuple[str, Optional[str], str], str],
+    ) -> Optional[str]:
+        name = dotted_name(expr)
+        if not name:
+            return None
+        if name.startswith("self."):
+            attr = name.split(".", 1)[1]
+            exact = locks.get((info.path, info.class_name, attr))
+            if exact is not None:
+                return exact
+            # Inherited lock (defined by a base's __init__): accept a
+            # same-file match only when it is unambiguous.
+            matches = sorted(
+                lock_id
+                for (path, _cls, lattr), lock_id in locks.items()
+                if path == info.path and lattr == attr
+            )
+            return matches[0] if len(matches) == 1 else None
+        if "." not in name:
+            return locks.get((info.path, None, name))
+        return None
+
+    def _collect_edges(
+        self, node, info, mod, graph, locks, closure, edges, held
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.With):
+                acquired = [
+                    lock
+                    for item in child.items
+                    for lock in [
+                        self._lock_of(item.context_expr, info, locks)
+                    ]
+                    if lock
+                ]
+                for lock in acquired:
+                    for holder in held:
+                        if holder == lock and self._kinds.get(
+                            lock
+                        ) != "Lock":
+                            # RLock/Condition re-acquisition is legal
+                            # reentrancy, not an ordering bug.
+                            continue
+                        edges.setdefault(
+                            (holder, lock),
+                            (
+                                info.path,
+                                child,
+                                "in %s" % info.name,
+                            ),
+                        )
+                self._collect_edges(
+                    child,
+                    info,
+                    mod,
+                    graph,
+                    locks,
+                    closure,
+                    edges,
+                    held + acquired,
+                )
+                continue
+            if isinstance(child, ast.Call) and held:
+                target = dotted_name(child.func)
+                resolved = (
+                    graph.resolve(target, mod, info) if target else None
+                )
+                if resolved is not None:
+                    for lock in sorted(closure.get(resolved, ())):
+                        for holder in held:
+                            if holder != lock:
+                                edges.setdefault(
+                                    (holder, lock),
+                                    (
+                                        info.path,
+                                        child,
+                                        "via call to %s from %s"
+                                        % (
+                                            _short_name(resolved),
+                                            info.name,
+                                        ),
+                                    ),
+                                )
+            self._collect_edges(
+                child, info, mod, graph, locks, closure, edges, held
+            )
+
+    @staticmethod
+    def _reaches(adj: Dict[str, Set[str]], src: str, dst: str) -> bool:
+        seen: Set[str] = set()
+        stack = [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(sorted(adj.get(cur, ())))
+        return False
+
+
+def _lock_short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+# ---------------------------------------------------------------- JL015
+
+
+class FaultSiteCoverageRule(Rule):
+    """Every registered fault site must be tripped AND test-armed.
+
+    The chaos-testing contract (`robustness/faults.py`) only means
+    something while three sets agree: sites REGISTERED in
+    `FAULT_SITES`, sites TRIPPED by product code (`faults.trip(...)`),
+    and sites ARMED by at least one test (`faults.arm(...)` or an
+    `ADANET_FAULTS="site:mode"` spec). A registered-but-untripped site
+    is dead weight; a registered-but-never-armed site is a chaos blind
+    spot — the failure mode exists in production but no test ever
+    exercises it; a tripped-but-unregistered site raises at runtime.
+    Arming evidence is gathered from the linted files plus the repo's
+    `tests/` tree (chaos runners arm via the environment).
+    """
+
+    rule_id = "JL015"
+    summary = "fault-site registry out of sync with trips/armed tests"
+    project = True
+
+    _ARM_RE = re.compile(
+        r"""arm\(\s*["']([a-z0-9_.]+)["']"""
+    )
+    #: A spec counts as arming evidence only as a QUOTED string literal
+    #: (`"site:mode..."`) or a `;`-separated continuation inside one —
+    #: prose in a docstring or an assertion message mentioning
+    #: `site:mode` mid-sentence must not mask a chaos blind spot.
+    _ENV_RE = re.compile(
+        r"""(?:["']|;)\s*([a-z0-9_.]+):"""
+        r"(?:error|transient|hang|kill|torn|rot)"
+    )
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        registry = self._find_registry(proj)
+        if registry is None:
+            return []
+        reg_path, sites = registry
+        ctx = proj.files[reg_path]
+        tripped = self._tripped_sites(proj)
+        armed = self._armed_sites(proj)
+
+        findings: List[Finding] = []
+        for site, node in sorted(sites.items()):
+            if site not in tripped and site not in armed:
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "fault site %r is registered but nothing trips "
+                        "it — dead registry entry (delete it, or "
+                        "instrument the seam it names)" % site,
+                    )
+                )
+            elif site not in armed:
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "fault site %r is registered and tripped but "
+                        "no test arms it — a chaos blind spot: the "
+                        "failure exists in production and is never "
+                        "exercised (arm it in a test or via "
+                        "ADANET_FAULTS in a chaos runner)" % site,
+                    )
+                )
+        # Trips of unregistered sites fail loudly at runtime; catch at
+        # review time instead.
+        for path in sorted(proj.files):
+            file_ctx = proj.files[path]
+            for node in ast.walk(file_ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name.split(".")[-1] != "trip" or not node.args:
+                    continue
+                arg = node.args[0]
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value not in sites
+                ):
+                    findings.append(
+                        file_ctx.finding(
+                            node,
+                            self.rule_id,
+                            "faults.trip(%r) names a site missing from "
+                            "FAULT_SITES — this raises ValueError the "
+                            "first time a chaos config arms it"
+                            % arg.value,
+                        )
+                    )
+        return findings
+
+    def _find_registry(
+        self, proj: ProjectContext
+    ) -> Optional[Tuple[str, Dict[str, ast.AST]]]:
+        for path in sorted(proj.files):
+            if not path.replace("\\", "/").endswith(
+                "robustness/faults.py"
+            ):
+                continue
+            ctx = proj.files[path]
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "FAULT_SITES"
+                        for t in node.targets
+                    )
+                ):
+                    sites: Dict[str, ast.AST] = {}
+                    for sub in ast.walk(node.value):
+                        if isinstance(
+                            sub, ast.Constant
+                        ) and isinstance(sub.value, str):
+                            sites[sub.value] = sub
+                    return path, sites
+        return None
+
+    def _tripped_sites(self, proj: ProjectContext) -> Set[str]:
+        tripped: Set[str] = set()
+        for path in sorted(proj.files):
+            for node in ast.walk(proj.files[path].tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name.split(".")[-1] == "trip" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        tripped.add(arg.value)
+        return tripped
+
+    def _armed_sites(self, proj: ProjectContext) -> Set[str]:
+        armed: Set[str] = set()
+        # Linted files: arm() calls and env-spec string literals.
+        for path in sorted(proj.files):
+            source = proj.files[path].source
+            armed.update(self._ARM_RE.findall(source))
+            armed.update(self._ENV_RE.findall(source))
+        # The repo's tests tree (chaos runners, pytest modules). The
+        # jaxlint fixture corpus is excluded — fixture registries must
+        # not be armed by other fixtures' sources.
+        tests_dir = os.path.join(proj.repo_root, "tests")
+        if os.path.isdir(tests_dir):
+            for root, dirnames, filenames in os.walk(tests_dir):
+                dirnames[:] = sorted(
+                    d
+                    for d in dirnames
+                    if d != "jaxlint_fixtures"
+                    and not d.startswith(".")
+                    and d != "__pycache__"
+                )
+                for fname in sorted(filenames):
+                    if not fname.endswith(".py"):
+                        continue
+                    try:
+                        with open(
+                            os.path.join(root, fname),
+                            "r",
+                            encoding="utf-8",
+                        ) as f:
+                            text = f.read()
+                    except OSError:
+                        continue
+                    armed.update(self._ARM_RE.findall(text))
+                    armed.update(self._ENV_RE.findall(text))
+        return armed
+
+
+PROTOCOL_RULES: List[Rule] = [
+    NonAtomicWriteRule(),
+    LockOrderRule(),
+    FaultSiteCoverageRule(),
+]

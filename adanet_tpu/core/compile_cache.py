@@ -108,20 +108,58 @@ class CompileCache:
     """
 
     def __init__(self, max_entries: int = 128, store=None):
+        from adanet_tpu.observability import metrics as metrics_lib
+
         self._executables = collections.OrderedDict()
         self._max_entries = int(max_entries)
         self._store = store
-        self.hits = 0
-        self.misses = 0
+        # Accounting lives on the process metrics registry
+        # (`compile_cache.*` aggregates across every cache instance —
+        # snapshots, flight dumps, bench.py); each instance holds scoped
+        # CHILD counters so the long-standing per-instance attribute API
+        # below (`cache.hits`, `cache.store_hits`, ...) keeps its exact
+        # semantics as thin reads.
+        reg = metrics_lib.registry()
+        self._m_hits = reg.counter("compile_cache.hits").child()
+        self._m_misses = reg.counter("compile_cache.misses").child()
         #: Persistent-tier accounting: `store_hits` skipped an XLA
         #: compile entirely (deserialized from the shared store);
         #: `store_misses` compiled fresh (and, when serializable,
         #: published); `store_errors` counts silent degradations
         #: (serialize/deserialize unsupported or a corrupt/unhealable
         #: blob) — those fall back to a plain compile.
-        self.store_hits = 0
-        self.store_misses = 0
-        self.store_errors = 0
+        self._m_store_hits = reg.counter("compile_cache.store_hits").child()
+        self._m_store_misses = reg.counter(
+            "compile_cache.store_misses"
+        ).child()
+        self._m_store_errors = reg.counter(
+            "compile_cache.store_errors"
+        ).child()
+
+    @property
+    def hits(self) -> int:
+        """In-memory executable reuses (per instance)."""
+        return self._m_hits.value
+
+    @property
+    def misses(self) -> int:
+        """XLA compiles paid by this instance."""
+        return self._m_misses.value
+
+    @property
+    def store_hits(self) -> int:
+        """Persistent-tier deserializations (no XLA pipeline)."""
+        return self._m_store_hits.value
+
+    @property
+    def store_misses(self) -> int:
+        """Fresh compiles that consulted the store first."""
+        return self._m_store_misses.value
+
+    @property
+    def store_errors(self) -> int:
+        """Silent persistent-tier degradations to a plain compile."""
+        return self._m_store_errors.value
 
     def _store_ref_name(self, digest: str, device_fp, in_tree, out_tree):
         from adanet_tpu.store import keys as store_keys
@@ -165,7 +203,7 @@ class CompileCache:
             # too — the fresh compile below republishes under this name
             # with a new blob instead of leaving a permanently dangling
             # ref the store fsck would flag forever.
-            self.store_errors += 1
+            self._m_store_errors.inc()
             try:
                 self._store.delete_ref(AOT_REF_KIND, ref_name)
             except OSError:
@@ -194,7 +232,7 @@ class CompileCache:
                 meta={"bytes": len(blob), "recreatable": True},
             )
         except Exception as exc:
-            self.store_errors += 1
+            self._m_store_errors.inc()
             _LOG.warning(
                 "Persistent compile tier: publish failed (%s: %s); "
                 "the executable stays process-local.",
@@ -237,7 +275,7 @@ class CompileCache:
                 )
                 executable = self._store_load(ref_name)
             if executable is not None:
-                self.store_hits += 1
+                self._m_store_hits.inc()
             else:
                 # The compile may read a persistent on-disk XLA cache
                 # (see utils/compile_cache_dir.py): a transient I/O
@@ -252,16 +290,16 @@ class CompileCache:
                 executable = with_retries(
                     compile_once, label="compile-cache read"
                 )
-                self.misses += 1
+                self._m_misses.inc()
                 if ref_name is not None:
-                    self.store_misses += 1
+                    self._m_store_misses.inc()
                     self._store_save(ref_name, executable)
             self._executables[key] = executable
             while len(self._executables) > self._max_entries:
                 self._executables.popitem(last=False)
         else:
             self._executables.move_to_end(key)
-            self.hits += 1
+            self._m_hits.inc()
         return executable
 
     def clear(self) -> None:

@@ -66,6 +66,8 @@ from adanet_tpu.distributed.placement import (
 )
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
+from adanet_tpu.observability import flightrec as flightrec_lib
+from adanet_tpu.observability import spans as spans_lib
 from adanet_tpu.robustness import faults as faults_lib
 from adanet_tpu.robustness import retry as retry_lib
 from adanet_tpu.robustness import watchdog as watchdog_lib
@@ -623,10 +625,35 @@ class Estimator:
             except ValueError:  # non-main interpreter contexts
                 handler_installed = False
 
+        # The telemetry plane: a flight recorder rooted at the model dir
+        # (shared with a serving pool on the same dir; a search over a
+        # NEW dir rebinds so its crashes dump under ITS model dir) and a
+        # search-scoped span whose correlation ID every nested span —
+        # iteration, work unit, checkpoint — inherits.
+        flightrec_lib.install_default(
+            os.path.join(self._model_dir, flightrec_lib.DEFAULT_SUBDIR)
+        )
+        self._search_id = "%s-p%d" % (
+            os.path.basename(os.path.normpath(self._model_dir)) or "search",
+            os.getpid(),
+        )
         try:
-            self._train_loop(
-                input_fn, max_steps, info, data_iter, cached_previous
-            )
+            with spans_lib.tracer().span(
+                "search",
+                correlation={"search_id": self._search_id},
+                max_steps=max_steps,
+            ):
+                self._train_loop(
+                    input_fn, max_steps, info, data_iter, cached_previous
+                )
+            if self._stop_requested:
+                # The SIGTERM checkpoint-and-stop path: leave the drain
+                # trace (dump runs OUTSIDE the signal handler).
+                flightrec_lib.dump_installed("sigterm_stop")
+            if self._peer_lost is not None:
+                flightrec_lib.dump_installed(
+                    "peer_lost", extra={"error": str(self._peer_lost)}
+                )
             if coordination.is_chief():
                 # Search end: record the replay config (winner indices +
                 # architecture hashes per completed iteration) so this
@@ -834,10 +861,14 @@ class Estimator:
                 # pulled under leases, dead workers' units re-issue, and
                 # freed capacity may speculate on t+1
                 # (distributed/scheduler.py, docs/scheduler.md).
-                state, steps_done = self._drain_elastic_iteration(
-                    executor, iteration, state, info, t, steps_done,
-                    max_steps, input_fn,
-                )
+                with spans_lib.tracer().span(
+                    "iteration.drain",
+                    correlation={"iteration": t},
+                ):
+                    state, steps_done = self._drain_elastic_iteration(
+                        executor, iteration, state, info, t, steps_done,
+                        max_steps, input_fn,
+                    )
             while (
                 not elastic
                 and steps_done < self._max_iteration_steps
@@ -893,14 +924,22 @@ class Estimator:
                         many_steps = lambda s, b: iteration.train_steps(
                             s, self._place_batch(b, stacked=True)
                         )
-                    if _same_shapes(batches):
-                        stacked = jax.tree_util.tree_map(
-                            lambda *xs: np.stack(xs), *batches
-                        )
-                        state, metrics = many_steps(state, stacked)
-                    else:
-                        for batch in batches:
-                            state, metrics = one_step(state, batch)
+                    with spans_lib.tracer().span(
+                        "train_window",
+                        correlation={"iteration": t},
+                        steps=loop_size,
+                    ):
+                        # Dispatch span: covers host-side tracing/enqueue
+                        # (device completion is async; device seconds
+                        # belong to the bench roofline).
+                        if _same_shapes(batches):
+                            stacked = jax.tree_util.tree_map(
+                                lambda *xs: np.stack(xs), *batches
+                            )
+                            state, metrics = many_steps(state, stacked)
+                        else:
+                            for batch in batches:
+                                state, metrics = one_step(state, batch)
                     steps_done += loop_size
                     info.global_step += loop_size
                 elif executor is not None:
@@ -910,9 +949,14 @@ class Estimator:
                         extra_batches[name], extra_iters[name] = (
                             self._next_batch(fn, extra_iters.get(name))
                         )
-                    state, metrics = executor.train_step(
-                        state, batch, extra_batches
-                    )
+                    with spans_lib.tracer().span(
+                        "train_window",
+                        correlation={"iteration": t},
+                        steps=1,
+                    ):
+                        state, metrics = executor.train_step(
+                            state, batch, extra_batches
+                        )
                     steps_done += 1
                     info.global_step += 1
                 else:
@@ -923,9 +967,14 @@ class Estimator:
                             fn, extra_iters.get(name)
                         )
                         extra_batches[name] = self._place_batch(raw)
-                    state, metrics = iteration.train_step(
-                        state, self._place_batch(batch), extra_batches
-                    )
+                    with spans_lib.tracer().span(
+                        "train_window",
+                        correlation={"iteration": t},
+                        steps=1,
+                    ):
+                        state, metrics = iteration.train_step(
+                            state, self._place_batch(batch), extra_batches
+                        )
                     steps_done += 1
                     info.global_step += 1
 
@@ -1726,17 +1775,23 @@ class Estimator:
         return state
 
     def _save_iteration_state(self, info, iteration_number, state) -> None:
-        stale = info.iteration_state_file
-        filename = ckpt_lib.iteration_state_filename(info.global_step)
-        info.digests[filename] = ckpt_lib.save_pytree(
-            self._model_dir, filename, state
-        )
-        info.iteration_number = iteration_number
-        info.iteration_state_file = filename
-        ckpt_lib.write_manifest(self._model_dir, info)
-        # The manifest now points at the new state; the superseded file
-        # would otherwise accumulate unboundedly over long searches.
-        self._remove_state_file(stale, keep=filename)
+        with spans_lib.tracer().span(
+            "checkpoint.save",
+            correlation={"iteration": iteration_number},
+            global_step=info.global_step,
+        ):
+            stale = info.iteration_state_file
+            filename = ckpt_lib.iteration_state_filename(info.global_step)
+            info.digests[filename] = ckpt_lib.save_pytree(
+                self._model_dir, filename, state
+            )
+            info.iteration_number = iteration_number
+            info.iteration_state_file = filename
+            ckpt_lib.write_manifest(self._model_dir, info)
+            # The manifest now points at the new state; the superseded
+            # file would otherwise accumulate unboundedly over long
+            # searches.
+            self._remove_state_file(stale, keep=filename)
 
     def _remove_state_file(self, filename, keep=None) -> None:
         if not filename or filename == keep:
@@ -1797,6 +1852,18 @@ class Estimator:
         so all processes reach the same winner, while artifacts are
         persisted once.
         """
+        with spans_lib.tracer().span(
+            "iteration.complete",
+            correlation={"iteration": iteration.iteration_number},
+            write=write,
+        ):
+            return self._complete_iteration_impl(
+                iteration, state, sample_batch, info, write
+            )
+
+    def _complete_iteration_impl(
+        self, iteration, state, sample_batch, info, write: bool = True
+    ):
         t = iteration.iteration_number
         best_index = self._get_best_ensemble_index(iteration, state)
         spec = iteration.ensemble_specs[best_index]

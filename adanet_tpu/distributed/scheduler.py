@@ -54,6 +54,8 @@ from adanet_tpu.distributed.executor import (
     CANDIDATE_FAULTS,
     RoundRobinExecutor,
 )
+from adanet_tpu.observability import metrics as metrics_lib
+from adanet_tpu.observability import spans as spans_lib
 from adanet_tpu.robustness import faults
 from adanet_tpu.robustness.watchdog import (
     PeerLostError,
@@ -375,6 +377,22 @@ class WorkQueue:
         self._units: List[WorkUnit] = []
         self._done_cache: Dict[str, dict] = {}
         self._poison_cache: Dict[str, str] = {}
+        # Lease-churn accounting on the process registry: the scheduler's
+        # recovery behavior used to be visible only in logs; these
+        # counters make "how many units re-issued after worker deaths"
+        # a snapshot read (flight dumps embed it).
+        reg = metrics_lib.registry()
+        self._m_claims = reg.counter("scheduler.lease.claims")
+        self._m_expiries = reg.counter("scheduler.lease.expiries")
+        # claim() observes the same expired lease on every poll until
+        # someone wins the re-issue; count each (unit, lease-attempt)
+        # expiry once or the counter inflates with poll frequency.
+        self._expiries_seen: set = set()
+        self._m_reissues = reg.counter("scheduler.lease.reissues")
+        self._m_renewals = reg.counter("scheduler.lease.renewals")
+        self._m_lost = reg.counter("scheduler.lease.lost")
+        self._m_completions = reg.counter("scheduler.units.completions")
+        self._m_poisoned = reg.counter("scheduler.units.poisoned")
 
     # ------------------------------------------------------------- keys
 
@@ -451,6 +469,12 @@ class WorkQueue:
         self._poison_cache[name] = reason
         if self._kv.set(self._key("poison", name), reason, overwrite=False):
             self._kv.set(self._key("final", name), str(int(final_step)))
+            self._m_poisoned.inc()
+            spans_lib.tracer().instant(
+                "scheduler.poison",
+                correlation={"candidate": name},
+                reason=str(reason),
+            )
             _LOG.error(
                 "Work-queue candidate %r poisoned after %d attempts: %s",
                 name,
@@ -507,9 +531,26 @@ class WorkQueue:
             elif float(lease["deadline"]) > now:
                 continue  # live lease: someone is (believed) working on it
             else:
+                expired = (unit.uid, int(lease["attempt"]))
+                if expired not in self._expiries_seen:
+                    self._expiries_seen.add(expired)
+                    self._m_expiries.inc()
                 attempt = int(lease["attempt"]) + 1
             won = self._claim_attempt(unit, attempt)
             if won is not None:
+                self._m_claims.inc()
+                if won > 0:
+                    # Attempt > 0 means a prior holder's lease expired
+                    # (or died mid-claim) and this unit re-issued.
+                    self._m_reissues.inc()
+                    spans_lib.tracer().instant(
+                        "scheduler.reissue",
+                        correlation={
+                            "candidate": unit.name,
+                            "work_unit": unit.uid,
+                        },
+                        attempt=won,
+                    )
                 return unit, won
         return None
 
@@ -598,11 +639,13 @@ class WorkQueue:
             or int(lease["attempt"]) != attempt
             or lease["owner"] != self.worker
         ):
+            self._m_lost.inc()
             raise LeaseLostError(
                 "lease on %s (attempt %d) re-issued to %s"
                 % (unit.uid, attempt, lease and lease.get("owner"))
             )
         self._write_lease(unit, attempt)
+        self._m_renewals.inc()
 
     def release(self, unit: WorkUnit, attempt: int) -> None:
         """Expires this worker's own lease so the unit re-issues
@@ -631,6 +674,8 @@ class WorkQueue:
             json.dumps({"owner": self.worker, "attempt": attempt}),
             overwrite=False,
         )
+        if won:
+            self._m_completions.inc()
         return won
 
     def read_blob(self, unit: WorkUnit, timeout_secs: float) -> bytes:
@@ -1042,7 +1087,16 @@ class ElasticWorkQueueExecutor(RoundRobinExecutor):
             unit, attempt = claim
             stall_deadline = self._clock() + config.drain_timeout_secs
             try:
-                with LeaseRenewer(queue, unit, attempt):
+                with spans_lib.tracer().span(
+                    "scheduler.workunit",
+                    correlation={
+                        "candidate": unit.name,
+                        "work_unit": unit.uid,
+                    },
+                    kind=unit.kind,
+                    attempt=attempt,
+                    steps=unit.num_steps,
+                ), LeaseRenewer(queue, unit, attempt):
                     if unit.kind == "subnetwork":
                         state_in = self._input_state(
                             unit, queue, states, unit_index, entry_steps

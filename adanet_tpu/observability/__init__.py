@@ -1,0 +1,49 @@
+"""Unified telemetry plane: spans, metrics, crash flight recorder.
+
+One subsystem narrates every layer of the framework (ISSUE 12):
+
+- `spans`: nestable spans with correlation IDs (search -> iteration ->
+  candidate -> work unit; request -> batch) recorded into a bounded
+  ring buffer by a process-wide `Tracer`. Injectable monotonic clock
+  (mocked-clock testable); near-zero cost when disabled — the overhead
+  gate asserts ZERO clock reads on the instrumented hot path.
+- `metrics`: a process-wide registry of counters/gauges/histograms
+  absorbing the accounting that used to live as private attributes on
+  the store, compile cache, scheduler, and serving plane; snapshots to
+  JSON.
+- `flightrec`: a crash flight recorder that dumps the ring buffer and a
+  metrics snapshot via staged+fsync+rename on fault-site trips, SIGTERM
+  drains, and `PeerLostError` — every chaos run leaves a readable
+  last-N-events trace instead of log archaeology.
+- `export`: Perfetto/Chrome-trace JSON export (`tools/trace_view.py` is
+  the CLI).
+
+Host-only module (jaxlint JL006): telemetry runs between device steps,
+never on them — and never reads the wall clock from jit-traced code
+(JL016).
+"""
+
+from adanet_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from adanet_tpu.observability.spans import (  # noqa: F401
+    SpanEvent,
+    Tracer,
+    tracer,
+)
+from adanet_tpu.observability.flightrec import (  # noqa: F401
+    FlightRecorder,
+    dump_installed,
+    install,
+    installed,
+    install_default,
+    uninstall,
+)
+from adanet_tpu.observability.export import (  # noqa: F401
+    chrome_trace,
+    write_chrome_trace,
+)

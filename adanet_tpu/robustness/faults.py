@@ -64,6 +64,7 @@ FAULT_SITES = frozenset(
         "store.put",  # store/blobstore.py blob publication (post-write)
         "store.get",  # store/blobstore.py blob read entry
         "store.gc",  # store/gc.py collection entry
+        "flightrec.dump",  # observability/flightrec.py stage->rename seam
     }
 )
 
@@ -207,6 +208,17 @@ def _fire(spec: FaultSpec, path: Optional[str], data: Optional[bytes]):
         spec.trips,
     )
     _LOG.error("FAULT TRIPPED site=%s mode=%s: %s", spec.site, spec.mode, message)
+    # Flight-record the trip BEFORE the failure action, so `kill`/`torn`
+    # (SIGKILL) still leave a readable trace of everything up to the
+    # injected failure. Lazy import: observability is optional here and
+    # the hook must never turn a deterministic chaos run into an import
+    # error.
+    try:
+        from adanet_tpu.observability import flightrec
+
+        flightrec.on_fault_trip(spec.site, spec.mode, spec.trips)
+    except Exception:  # telemetry must not alter fault semantics
+        _LOG.exception("Flight-recorder fault hook failed; continuing.")
     if spec.mode == "error":
         raise InjectedFault(message)
     if spec.mode == "transient":

@@ -35,6 +35,7 @@ import numpy as np
 import jax
 
 from adanet_tpu.core.compile_cache import CachedStep, CompileCache
+from adanet_tpu.observability import metrics as metrics_lib
 from adanet_tpu.robustness import faults
 from adanet_tpu.serving.model_pool import (
     GenerationRecord,
@@ -159,6 +160,19 @@ class Batcher:
             )
         self._cache = compile_cache or CompileCache(max_entries=32)
         self._steps: Dict[int, CachedStep] = {}
+        # Bucket occupancy (real rows / bucket rows per dispatch) tells
+        # the replica balancer whether padding — i.e. the compiled-shape
+        # budget — or traffic is wasting device time; canary divergence
+        # mirrors the health signal the flip gate consumes.
+        reg = metrics_lib.registry()
+        self._h_occupancy = reg.histogram(
+            "serving.batcher.bucket_occupancy",
+            boundaries=(0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self._m_dispatches = reg.counter("serving.batcher.dispatches")
+        self._g_canary_divergence = reg.gauge(
+            "serving.batcher.canary_divergence"
+        )
 
     @property
     def max_batch(self) -> int:
@@ -202,6 +216,8 @@ class Batcher:
         sizes = [request_rows(f) for f in features_list]
         bucket = bucket_for(sum(sizes), self.config.bucket_sizes)
         padded, _ = pad_batch(features_list, bucket)
+        self._m_dispatches.inc()
+        self._h_occupancy.observe(sum(sizes) / float(bucket))
         faults.trip("serving.batch_execute")
         outputs = self._step_for(record)(padded)
         split = split_rows(outputs, sizes)
@@ -231,4 +247,6 @@ class Batcher:
                 exc,
             )
             ok, divergence = False, None
+        if divergence is not None:
+            self._g_canary_divergence.set(divergence)
         self.pool.report_canary(ok, divergence)

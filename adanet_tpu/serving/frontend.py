@@ -39,11 +39,16 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
 import logging
 import signal
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+
+from adanet_tpu.observability import metrics as metrics_lib
+from adanet_tpu.observability import spans as spans_lib
+from adanet_tpu.observability import flightrec
 
 _LOG = logging.getLogger("adanet_tpu")
 
@@ -177,6 +182,7 @@ class _Request:
         "enqueued_at",
         "done",
         "result",
+        "rid",
     )
 
     def __init__(self, features, deadline, enqueued_at):
@@ -185,6 +191,7 @@ class _Request:
         self.enqueued_at = enqueued_at
         self.done = threading.Event()
         self.result: Optional[ServeResult] = None
+        self.rid = 0
 
     def respond(self, result: ServeResult) -> None:
         self.result = result
@@ -218,10 +225,41 @@ class ServingFrontend:
         self._cond = threading.Condition()
         self._started = False
         self._draining = False
+        self._signal_drain = False
         self._stopped = threading.Event()
         self._drained = threading.Event()
         self._threads: List[threading.Thread] = []
         self.counters: Dict[str, int] = collections.Counter()
+        self._request_ids = itertools.count(1)
+        self._batch_ids = itertools.count(1)
+        # Exported backpressure watermarks (ROADMAP item 2's replica
+        # balancer consumes these): queue depth, queue-wait EWMA, the
+        # batch-exec EWMA feeding deadline budgets, and per-status
+        # counters (sheds included) — all on the process registry so a
+        # balancer polls ONE snapshot instead of N private stats() APIs.
+        reg = metrics_lib.registry()
+        self._g_depth = reg.gauge("serving.frontend.queue_depth")
+        self._g_wait_ewma = reg.gauge("serving.frontend.wait_ewma_secs")
+        self._g_exec_ewma = reg.gauge("serving.frontend.exec_ewma_secs")
+        self._g_shedding = reg.gauge("serving.frontend.shedding")
+        self._m_status = {
+            status: reg.counter("serving.frontend.status.%s" % status)
+            for status in (
+                STATUS_OK,
+                STATUS_SHED,
+                STATUS_DEADLINE,
+                STATUS_UNAVAILABLE,
+                STATUS_DRAINING,
+                STATUS_INVALID,
+                STATUS_ERROR,
+            )
+        }
+
+    def _count(self, status: str) -> None:
+        self.counters[status] += 1
+        counter = self._m_status.get(status)
+        if counter is not None:
+            counter.inc()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -268,6 +306,10 @@ class ServingFrontend:
             _LOG.warning(
                 "SIGTERM: draining the serving queue, then exiting."
             )
+            # Bare attribute write, same async-signal-safety argument
+            # as request_drain: marks this drain as signal-initiated so
+            # the executor's tail dump carries an honest reason.
+            self._signal_drain = True
             self.request_drain()
             if callable(previous) and previous not in (
                 signal.SIG_IGN,
@@ -293,6 +335,7 @@ class ServingFrontend:
             else self.config.default_deadline_secs
         )
         request = _Request(features, deadline, now)
+        request.rid = next(self._request_ids)
         retry = self.config.retry_after_secs
         # A request the batcher could never place (no feature leaves, or
         # more rows than the largest bucket) is the CLIENT's fault: an
@@ -303,7 +346,7 @@ class ServingFrontend:
 
             rows = request_rows(features)
         except Exception as exc:
-            self.counters[STATUS_INVALID] += 1
+            self._count(STATUS_INVALID)
             request.respond(
                 ServeResult(
                     status=STATUS_INVALID,
@@ -312,7 +355,7 @@ class ServingFrontend:
             )
             return request
         if rows > self.batcher.max_batch:
-            self.counters[STATUS_INVALID] += 1
+            self._count(STATUS_INVALID)
             request.respond(
                 ServeResult(
                     status=STATUS_INVALID,
@@ -322,7 +365,7 @@ class ServingFrontend:
             )
             return request
         if self.pool.active is None:
-            self.counters[STATUS_UNAVAILABLE] += 1
+            self._count(STATUS_UNAVAILABLE)
             request.respond(
                 ServeResult(
                     status=STATUS_UNAVAILABLE,
@@ -333,7 +376,7 @@ class ServingFrontend:
             return request
         with self._cond:
             if self._draining:
-                self.counters[STATUS_DRAINING] += 1
+                self._count(STATUS_DRAINING)
                 request.respond(
                     ServeResult(
                         status=STATUS_DRAINING, retry_after=retry
@@ -341,12 +384,13 @@ class ServingFrontend:
                 )
                 return request
             if not self.admission.admit(len(self._queue)):
-                self.counters[STATUS_SHED] += 1
+                self._count(STATUS_SHED)
                 request.respond(
                     ServeResult(status=STATUS_SHED, retry_after=retry)
                 )
                 return request
             self._queue.append(request)
+            self._g_depth.set(len(self._queue))
             self._cond.notify_all()
         return request
 
@@ -431,13 +475,22 @@ class ServingFrontend:
         while True:
             batch = self._take_batch()
             if batch is None:
+                # Drained-and-stopped after a SIGTERM: leave a trace of
+                # the drain (the signal lifecycle's observable tail).
+                # Runs on the executor thread, never in the signal
+                # handler. Programmatic drain() — every test's and
+                # orderly stop's clean-shutdown path — is not an
+                # incident and writes no dump.
+                if self._signal_drain:
+                    flightrec.dump_installed("sigterm_drain")
                 return
+            self._g_depth.set(len(self._queue))
             now = self._clock()
             ready: List[_Request] = []
             for request in batch:
                 self.admission.observe_wait(now - request.enqueued_at)
                 if self.budget.expired(request.deadline, now):
-                    self.counters[STATUS_DEADLINE] += 1
+                    self._count(STATUS_DEADLINE)
                     request.respond(
                         ServeResult(
                             status=STATUS_DEADLINE,
@@ -446,17 +499,26 @@ class ServingFrontend:
                     )
                 else:
                     ready.append(request)
+            self._g_wait_ewma.set(self.admission.wait_ewma)
+            self._g_shedding.set(1.0 if self.admission.shedding else 0.0)
             if not ready:
                 continue
             started = self._clock()
+            span = spans_lib.tracer().span(
+                "serving.batch",
+                correlation={"batch": next(self._batch_ids)},
+                requests=[request.rid for request in ready],
+            )
             try:
-                record, outputs = self.batcher.execute(
-                    [request.features for request in ready]
-                )
+                with span:
+                    record, outputs = self.batcher.execute(
+                        [request.features for request in ready]
+                    )
+                    span.set(generation=record.iteration_number)
             except Exception as exc:
                 _LOG.exception("Serving batch failed.")
                 for request in ready:
-                    self.counters[STATUS_ERROR] += 1
+                    self._count(STATUS_ERROR)
                     request.respond(
                         ServeResult(
                             status=STATUS_ERROR,
@@ -465,8 +527,9 @@ class ServingFrontend:
                     )
                 continue
             self.budget.observe(self._clock() - started)
+            self._g_exec_ewma.set(self.budget.estimate)
             for request, out in zip(ready, outputs):
-                self.counters[STATUS_OK] += 1
+                self._count(STATUS_OK)
                 request.respond(
                     ServeResult(
                         status=STATUS_OK,

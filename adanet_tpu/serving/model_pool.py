@@ -182,6 +182,21 @@ class ModelPool:
         self.flips = 0
         self.rollbacks = 0
         self.events: List[Dict[str, Any]] = []
+        # Telemetry: flip/rollback counters on the process registry, and
+        # a flight recorder rooted at the model dir (shared with a
+        # same-dir searcher; a pool over a new dir rebinds), so a
+        # rot-rejected flip in a SERVING process leaves a readable
+        # trace just like a searcher crash does.
+        from adanet_tpu.observability import flightrec
+        from adanet_tpu.observability import metrics as metrics_lib
+
+        reg = metrics_lib.registry()
+        self._m_flips = reg.counter("serving.pool.flips")
+        self._m_rollbacks = reg.counter("serving.pool.rollbacks")
+        self._m_rejects = reg.counter("serving.pool.rejects")
+        flightrec.install_default(
+            os.path.join(model_dir, flightrec.DEFAULT_SUBDIR)
+        )
 
     # ------------------------------------------------------------ accessors
 
@@ -369,10 +384,18 @@ class ModelPool:
     # ----------------------------------------------------- promote / reject
 
     def _promote_locked(self, record: GenerationRecord, how: str) -> None:
+        from adanet_tpu.observability import spans as spans_lib
+
         previous = self._active
         self._active = record
         self._canary = None
         self.flips += 1
+        self._m_flips.inc()
+        spans_lib.tracer().instant(
+            "serving.flip",
+            generation=record.iteration_number,
+            how=how,
+        )
         self.events.append(
             {
                 "event": "flip",
@@ -458,8 +481,13 @@ class ModelPool:
         self._store_lease = None
 
     def _reject(self, t: int, path: str, reason: str) -> None:
+        from adanet_tpu.observability import flightrec
+        from adanet_tpu.observability import spans as spans_lib
+
         with self._lock:
             self.rollbacks += 1
+            self._m_rollbacks.inc()
+            self._m_rejects.inc()
             incumbent = self._active
             self.events.append(
                 {
@@ -476,6 +504,13 @@ class ModelPool:
             reason,
             incumbent.iteration_number if incumbent else None,
         )
+        # A rejected flip is a forensic event even when no fault site
+        # tripped (a raising mode already dumped via the trip hook; a
+        # rot mode is SILENT until this digest rejection catches it).
+        spans_lib.tracer().instant(
+            "serving.rollback", generation=t, reason=str(reason)
+        )
+        flightrec.dump_installed("serving_rollback:gen-%d" % t)
         if not self.config.quarantine:
             return
         target = path + QUARANTINE_SUFFIX

@@ -33,6 +33,7 @@ import tempfile
 import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
+from adanet_tpu.observability import spans as spans_lib
 from adanet_tpu.robustness import faults
 from adanet_tpu.robustness.retry import with_retries
 from adanet_tpu.store import keys
@@ -106,6 +107,8 @@ class ArtifactStore:
     """
 
     def __init__(self, root: str, clock=time.time):
+        from adanet_tpu.observability import metrics as metrics_lib
+
         self.root = os.path.abspath(root)
         self.clock = clock
         for sub in (
@@ -115,6 +118,43 @@ class ArtifactStore:
             STAGING_SUBDIR,
         ):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        # Accounting on the process metrics registry (`store.*`
+        # aggregates across instances for snapshots/flight dumps); the
+        # instance keeps scoped child counters so fsck reports and tests
+        # read exact per-store numbers via the properties below.
+        reg = metrics_lib.registry()
+        self._m_puts = reg.counter("store.blob.puts").child()
+        self._m_gets = reg.counter("store.blob.gets").child()
+        self._m_heals = reg.counter("store.blob.heals").child()
+        self._m_quarantines = reg.counter("store.blob.quarantines").child()
+        self._m_unrecoverable = reg.counter(
+            "store.blob.unrecoverable"
+        ).child()
+
+    @property
+    def puts(self) -> int:
+        """Blob publications (including deduplicated re-puts)."""
+        return self._m_puts.value
+
+    @property
+    def gets(self) -> int:
+        """Blob reads (verified-on-read; healed reads count once)."""
+        return self._m_gets.value
+
+    @property
+    def heals(self) -> int:
+        """Blobs rewritten from a duplicate referencer or fresh put."""
+        return self._m_heals.value
+
+    @property
+    def quarantines(self) -> int:
+        """Corrupt blob copies moved aside as `*.corrupt`."""
+        return self._m_quarantines.value
+
+    @property
+    def unrecoverable(self) -> int:
+        """Reads that failed after exhausting every heal source."""
+        return self._m_unrecoverable.value
 
     # ----------------------------------------------------------- paths
 
@@ -177,6 +217,7 @@ class ArtifactStore:
                 ) != digest:
                     self._quarantine_blob(digest)
                     _atomic_write_bytes(final, data, self.staging_dir)
+                    self._m_heals.inc()
                     _LOG.warning(
                         "Healed corrupt blob %s from a fresh put.",
                         digest[:12],
@@ -200,9 +241,11 @@ class ArtifactStore:
             # exactly the storage failures the verify-on-read and
             # heal-on-put machinery above must absorb.
             faults.trip("store.put", path=final, data=data)
+            self._m_puts.inc()
             return digest
 
-        return with_retries(put_once, label="store put")
+        with spans_lib.tracer().span("store.put", bytes=len(data)):
+            return with_retries(put_once, label="store put")
 
     def get(
         self, digest: str, extra_sources: Sequence[str] = ()
@@ -217,6 +260,13 @@ class ArtifactStore:
         `BlobCorruptError`/`BlobMissingError` when nothing can.
         """
         path = self.blob_path(digest)
+        self._m_gets.inc()
+        with spans_lib.tracer().span("store.get", digest=digest[:12]):
+            return self._get_verified(digest, path, extra_sources)
+
+    def _get_verified(
+        self, digest: str, path: str, extra_sources: Sequence[str]
+    ) -> bytes:
         faults.trip("store.get", path=path)
         try:
             data = _read_bytes(path, "store blob read")
@@ -252,6 +302,7 @@ class ArtifactStore:
         except FileNotFoundError:
             # A concurrent healer won the rename; same outcome.
             return None
+        self._m_quarantines.inc()
         return os.path.basename(target)
 
     def _heal(
@@ -277,6 +328,7 @@ class ArtifactStore:
             final = self.blob_path(digest)
             os.makedirs(os.path.dirname(final), exist_ok=True)
             _atomic_write_bytes(final, data, self.staging_dir)
+            self._m_heals.inc()
             _LOG.warning(
                 "Healed blob %s (%s) from duplicate referencer %s.",
                 digest[:12],
@@ -284,6 +336,7 @@ class ArtifactStore:
                 source,
             )
             return data
+        self._m_unrecoverable.inc()
         err = BlobMissingError if reason == "blob missing" else BlobCorruptError
         raise err(
             "blob %s unrecoverable (%s; %d heal sources tried)"

@@ -528,6 +528,153 @@ def _serving_latency_section():
         }
 
 
+def _measure_roofline(builders, batch_size, steps=None, model_name=None):
+    """Per-component roofline of one candidate training step (ROADMAP
+    item 1: "report a per-component roofline breakdown in bench.py so
+    the next round knows what to attack").
+
+    Four components, each wrapped in a span on a dedicated tracer so the
+    breakdown is ALSO an exportable trace (`ADANET_BENCH_TRACE_EXPORT`):
+
+      compile       jit trace + XLA pipeline of the per-step program
+      input_pull    host->device transfer of one global batch
+      device_step   `steps` training dispatches — the DEVICE clock
+                    (profiler XLA Modules lane) when available, else the
+                    host wall clock (`step_clock` says which)
+      host_fetch    device->host fetch of the step metrics
+
+    `fractions` normalizes a steady-state step: input_pull is charged
+    PER STEP (every step consumes one batch transfer of exactly the
+    measured shape), device_step per step, and host_fetch amortized
+    over the window (the production scan path fetches metrics once per
+    dispatch window, not per step); compile is a one-time cost reported
+    as `compile_secs` and per-step-amortized over `steps`. So "the
+    hardware is ~90% idle" decomposes into which component to attack.
+    """
+    from adanet_tpu.observability import metrics as metrics_lib
+    from adanet_tpu.observability.spans import Tracer
+    from adanet_tpu.utils.device_timing import time_steps_on_device
+
+    steps = steps or MEASURE_STEPS
+    tracer = Tracer(capacity=64, clock=time.perf_counter)
+    iteration = _build_bench_iteration(builders)
+    num_chips = jax.device_count()
+    rng = np.random.RandomState(0)
+    global_batch = batch_size * num_chips
+    host_batch = (
+        {
+            "image": rng.randn(
+                global_batch, IMAGE_SIZE, IMAGE_SIZE, 3
+            ).astype(np.float32)
+        },
+        rng.randint(0, 10, size=(global_batch,)),
+    )
+
+    with tracer.span("roofline.input_pull", rows=global_batch):
+        batch = jax.device_put(host_batch)
+        jax.block_until_ready(batch)
+    state = iteration.init_state(jax.random.PRNGKey(0), batch)
+    jitted = jax.jit(iteration._train_step_impl, donate_argnums=0)
+    with tracer.span("roofline.compile"):
+        compiled = jitted.lower(state, batch, {}).compile()
+
+    holder = {"state": state, "metrics": None}
+
+    def run_steps():
+        st = holder["state"]
+        metrics = None
+        for _ in range(steps):
+            st, metrics = compiled(st, batch, {})
+        jax.block_until_ready(metrics)
+        holder["state"], holder["metrics"] = st, metrics
+
+    # Warm up one dispatch outside the timed window (first-dispatch
+    # runtime setup would pollute the per-step number); state buffers
+    # are donated, so thread the returned state through.
+    st, _warm_metrics = compiled(holder["state"], batch, {})
+    jax.block_until_ready(_warm_metrics)
+    holder["state"] = st
+
+    # One timed loop, not two: the span wraps whichever run produced
+    # the number (the profiled run on the device path; a fresh untraced
+    # run on the host fallback — the profiled attempt's wall time
+    # carries tracing overhead, so it prices nothing).
+    try:
+        with tracer.span(
+            "roofline.device_step", steps=steps, clock="device"
+        ):
+            total, _ = time_steps_on_device(
+                run_steps, expected_dispatches=steps * num_chips
+            )
+        step_secs = total / num_chips / steps
+        step_clock = "device"
+    except Exception as exc:
+        sys.stderr.write(
+            "roofline: device clock unavailable (%s: %s); host wall "
+            "clock\n" % (type(exc).__name__, exc)
+        )
+        with tracer.span(
+            "roofline.device_step", steps=steps, clock="host_fallback"
+        ):
+            started = time.perf_counter()
+            run_steps()
+            step_secs = (time.perf_counter() - started) / steps
+        step_clock = "host_fallback"
+    with tracer.span("roofline.host_fetch"):
+        fetched = jax.device_get(holder["metrics"])
+    del fetched
+    events = {e.name: e for e in tracer.events()}
+
+    compile_secs = events["roofline.compile"].duration
+    input_secs = events["roofline.input_pull"].duration
+    fetch_secs = events["roofline.host_fetch"].duration
+    # The registry absorbs per-step device time like every other
+    # subsystem's accounting (flight dumps and snapshots see it).
+    metrics_lib.registry().histogram("bench.step_secs").observe(step_secs)
+    steady = input_secs + step_secs + fetch_secs / steps
+    amortized = steady + compile_secs / steps
+    out = {
+        "model_name": model_name,
+        "steps": steps,
+        "global_batch": global_batch,
+        "compile_secs": round(compile_secs, 4),
+        "input_pull_secs": round(input_secs, 4),
+        "device_step_secs_per_step": round(step_secs, 6),
+        "host_fetch_secs": round(fetch_secs, 4),
+        "step_clock": step_clock,
+        # Steady-state attribution of one step (compile excluded;
+        # one batch transfer per step, one metrics fetch per window).
+        "fractions": {
+            "input_pull": round(input_secs / steady, 4),
+            "device_step": round(step_secs / steady, 4),
+            "host_fetch": round(fetch_secs / steps / steady, 4),
+        },
+        "compile_amortized_fraction": round(
+            (compile_secs / steps) / amortized, 4
+        ),
+    }
+    export_path = os.environ.get("ADANET_BENCH_TRACE_EXPORT")
+    if export_path:
+        from adanet_tpu.observability.export import write_chrome_trace
+
+        write_chrome_trace(export_path, tracer.events())
+        out["trace_export"] = export_path
+    return out
+
+
+def _roofline_section(builders_fn, batch_size, model_name=None):
+    """`roofline` with the structured-skip contract of every section."""
+    try:
+        return _measure_roofline(
+            builders_fn(), batch_size, model_name=model_name
+        )
+    except Exception as exc:
+        return {
+            "skipped": "roofline_bench_failed",
+            "error": "%s: %s" % (type(exc).__name__, exc),
+        }
+
+
 def _measure_warm_start():
     """Compile-cache hit/miss accounting across separate search runs
     sharing one content-addressed artifact store (ROADMAP item 5 gate).
@@ -777,6 +924,15 @@ def _emit_unavailable_record():
         # Warm starts are host+store machinery; the accounting is real
         # on CPU (first numbers: BENCH_warmstart_r01.json).
         "warm_start": _warm_start_section(),
+        # Per-component step attribution stays meaningful on CPU (the
+        # components exist on every backend; step_clock says host).
+        "roofline": _roofline_section(
+            lambda: [__import__(
+                "adanet_tpu.examples.simple_cnn", fromlist=["CNNBuilder"]
+            ).CNNBuilder(num_blocks=1, channels=8)],
+            batch_size=8,
+            model_name="cnn_tiny",
+        ),
     }
     if contract_error:
         result["cpu_contract_error"] = contract_error
@@ -907,6 +1063,14 @@ def main():
         # Compile-cache hit/miss accounting across two separate search
         # runs sharing one content-addressed artifact store.
         "warm_start": _warm_start_section(),
+        # Per-component attribution of the flagship NASNet step
+        # (compile / input-pull / device-step / host-fetch) — the
+        # breakdown the MFU campaign attacks component by component.
+        "roofline": _roofline_section(
+            lambda: [nasnet_builder()],
+            batch_size=NASNET_BATCH,
+            model_name=model_name,
+        ),
         "device_kind": jax.devices()[0].device_kind,
         "num_chips": jax.device_count(),
         "flops_model": "XLA compiled-program cost_analysis()",

@@ -1,0 +1,38 @@
+"""Chaos runner: SIGKILL the flight recorder mid-dump-write.
+
+Spawned by `test_observability.py` with
+`ADANET_FAULTS="flightrec.dump:kill:after=1"`: the FIRST dump's
+stage->rename seam is a clean hit; the SECOND dump is SIGKILLed between
+staging and rename — mid-write. The parent asserts the invariant the
+staged+fsync+rename protocol buys: the prior dump at the final path
+stays intact and parseable, and no partial dump is ever readable (the
+abandoned stage file is an identifiable `.stage-*` stray, reclaimed by
+the next dump).
+
+No jax import: the flight recorder is pure host machinery.
+"""
+
+import sys
+
+from adanet_tpu.observability import FlightRecorder, install
+
+
+def main():
+    directory = sys.argv[1]
+    recorder = install(FlightRecorder(directory))
+    tracer = recorder.tracer
+    tracer.enable()
+    with tracer.span("chaos.phase", correlation={"search_id": "chaos"}):
+        tracer.instant("first.marker")
+    path = recorder.dump("first")
+    assert path, "first dump failed"
+    print("FIRST DUMP OK", flush=True)
+    tracer.instant("second.marker")
+    # The armed kill fires between stage and rename: lights out
+    # mid-write, stage stray abandoned, prior dump untouched.
+    recorder.dump("second")
+    print("UNEXPECTED SECOND DUMP COMPLETION", flush=True)
+
+
+if __name__ == "__main__":
+    main()

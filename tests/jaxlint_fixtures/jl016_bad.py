@@ -1,0 +1,28 @@
+"""JL016 bad: wall-clock reads reachable from jit-traced code."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def timed_step(state, batch):
+    started = time.perf_counter()  # expect: JL016
+    out = state + jnp.sum(batch)
+    return out, time.time() - started  # expect: JL016
+
+
+def _stamp(metrics):
+    # Two frames below the jit entry: still trace-time.
+    metrics["at"] = time.monotonic()  # expect: JL016
+    return metrics
+
+
+def _annotate(metrics):
+    return _stamp(metrics)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def annotated_step(state):
+    return _annotate({"loss": state})

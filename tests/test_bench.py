@@ -120,6 +120,24 @@ def test_bench_prints_one_json_line():
     assert warm["cold"]["xla_compiles"] > 0
     assert warm["shared_store_fresh"]["store_hits"] > 0
     assert warm["store"]["clean"] is True
+    # Per-component roofline (ISSUE 12): step time attributed across
+    # compile / input-pull / device-step / host-fetch, with an honest
+    # clock label (CPU has no XLA Modules device lane -> host fallback).
+    roofline = result["roofline"]
+    assert "skipped" not in roofline, roofline
+    for key in (
+        "compile_secs",
+        "input_pull_secs",
+        "device_step_secs_per_step",
+        "host_fetch_secs",
+    ):
+        assert roofline[key] >= 0, roofline
+    assert roofline["compile_secs"] > 0
+    assert roofline["device_step_secs_per_step"] > 0
+    assert roofline["step_clock"] in ("device", "host_fallback")
+    fractions = roofline["fractions"]
+    assert set(fractions) == {"input_pull", "device_step", "host_fetch"}
+    assert sum(fractions.values()) == pytest.approx(1.0, abs=0.01)
     # On CPU there is no axon tunnel: no timing caveat, no MFU peak.
     assert "timing_caveat" not in result
 
@@ -198,3 +216,9 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     warm = result["warm_start"]
     assert "skipped" not in warm, warm
     assert warm["zero_compile_warm_start"] is True, warm
+    # The roofline components exist on every backend: the outage record
+    # still attributes a (tiny-CNN) step across all four.
+    roofline = result["roofline"]
+    assert "skipped" not in roofline, roofline
+    assert roofline["device_step_secs_per_step"] > 0
+    assert roofline["step_clock"] == "host_fallback"

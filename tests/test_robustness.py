@@ -705,6 +705,28 @@ def torn_model_dir(tmp_path_factory):
     )
     assert proc.returncode == -signal.SIGKILL, proc.stdout.decode()[-2000:]
     assert b"UNEXPECTED COMPLETION" not in proc.stdout
+    # ISSUE 12: the trip hook flight-dumped BEFORE the SIGKILL — the
+    # armed torn fault leaves an intact prior dump (staged+fsync+rename;
+    # no partial file at a readable dump path), narrating the search up
+    # to the trip inside its span tree.
+    import glob as glob_lib
+
+    from adanet_tpu.observability.flightrec import load_dump
+
+    [dump_path] = glob_lib.glob(
+        os.path.join(d, "flightrec", "flight-*.json")
+    )
+    dump = load_dump(dump_path)  # parseable = intact, never partial
+    assert dump["reason"] == "fault:checkpoint.write:torn"
+    [trip] = [
+        e for e in dump["events"] if e["name"] == "fault.trip"
+    ]
+    assert trip["attrs"]["site"] == "checkpoint.write"
+    assert trip["attrs"]["mode"] == "torn"
+    assert "search_id" in trip["correlation"]
+    assert {"train_window", "checkpoint.save"} <= {
+        e["name"] for e in dump["events"]
+    }
     # The torn orphan is at the final path; the manifest still points at
     # the last intact generation.
     assert os.path.exists(os.path.join(d, "ckpt-6.msgpack"))

@@ -545,6 +545,11 @@ def test_serve_while_search_chaos_flips_and_bit_identity(tmp_path):
     to offline `load_serving_program` evaluation."""
     model_dir = str(tmp_path / "model")
 
+    # The pool's install_default must own this test's flight dir (an
+    # earlier test's pool may hold the process-wide slot).
+    from adanet_tpu.observability import flightrec
+
+    flightrec.uninstall()
     pool = ModelPool(model_dir, PoolConfig(canary_requests=2))
     batcher = Batcher(pool, BatcherConfig(bucket_sizes=(4, 8)))
     frontend = ServingFrontend(
@@ -636,6 +641,30 @@ def test_serve_while_search_chaos_flips_and_bit_identity(tmp_path):
     assert glob.glob(
         os.path.join(model_dir, "serving", "gen-1.corrupt*")
     )
+
+    # ISSUE 12 acceptance: the rot-rejected flip left a flight-recorder
+    # dump in THIS (serving) process — the `serving.flip` trip hook
+    # dumped at the fault, and the digest rejection dumped again with
+    # the rollback instant, so chaos forensics read as a trace.
+    from adanet_tpu.observability.flightrec import load_dump
+
+    dump_path = os.path.join(
+        model_dir, "flightrec", "flight-%d.json" % os.getpid()
+    )
+    assert os.path.exists(dump_path), os.listdir(
+        os.path.join(model_dir, "flightrec")
+    )
+    dump = load_dump(dump_path)
+    assert any(
+        r.startswith("fault:serving.flip:rot") for r in dump["reasons"]
+    ), dump["reasons"]
+    assert any(
+        r.startswith("serving_rollback") for r in dump["reasons"]
+    ), dump["reasons"]
+    rollbacks = [
+        e for e in dump["events"] if e["name"] == "serving.rollback"
+    ]
+    assert rollbacks and rollbacks[-1]["attrs"]["generation"] == 1
 
     # Served responses answered during gen-0 incumbency differ from
     # gen-2's: each response's `generation` tags its source, and every

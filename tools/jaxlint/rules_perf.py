@@ -1,4 +1,4 @@
-"""The jaxlint perf pack: JL010-JL012, MFU-campaign rules.
+"""The jaxlint perf pack: JL010-JL012 + JL016, MFU-campaign rules.
 
 ROADMAP item 1 (NASNet MFU 0.107 -> 0.35+) is an audit problem as much
 as a kernel problem: dtype upcasts that silently drag a bf16 compute
@@ -6,8 +6,9 @@ path back to f32, loop-invariant constructors re-executed inside every
 `lax.scan` iteration, and per-step device->host transfers in the host
 training loop each burn a slice of the hardware the profile then shows
 as "idle". These rules make those patterns un-mergeable instead of
-re-discovered per profiling round. All three are interprocedural over
-`tools.jaxlint.callgraph`.
+re-discovered per profiling round. JL016 guards the telemetry plane's
+clock discipline (wall-clock reads must stay outside traced code). All
+are interprocedural over `tools.jaxlint.callgraph`.
 """
 
 from __future__ import annotations
@@ -445,8 +446,117 @@ class HostLoopTransferRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------- JL016
+
+
+class WallClockOnTracedPathRule(Rule):
+    """Wall-clock reads reachable from jit-traced code, repo-wide.
+
+    `time.time()`/`perf_counter()`/`monotonic()` inside traced code does
+    not measure the device: it executes ONCE at trace time and the value
+    is constant-folded into the program, so the "timestamp" is frozen at
+    compile and every cached execution reuses it — a silently wrong
+    metric. Telemetry belongs OUTSIDE traced code (the observability
+    tracer's injected clock); on-device timing belongs to the profiler
+    lanes (`utils/device_timing.py`). Interprocedural like JL002: a
+    clock read buried two helpers below the jit entry is attributed to
+    the entry with the full call chain.
+    """
+
+    rule_id = "JL016"
+    summary = "wall-clock read on a jit-traced path"
+    project = True
+
+    #: Dotted call names that read a host clock.
+    _CLOCK_CALLS = {
+        "time.time",
+        "time.time_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.process_time",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+    #: Bare names covering `from time import perf_counter` style (the
+    #: ambiguous bare `time` is excluded — too collision-prone).
+    _CLOCK_BARE = {
+        "perf_counter",
+        "perf_counter_ns",
+        "monotonic",
+        "monotonic_ns",
+        "process_time",
+    }
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+        from tools.jaxlint.rules import HostSyncRule
+
+        graph = proj.graph
+        if not graph.jit_entries:
+            return []
+        # The same host-helper boundary as JL002: traversal never enters
+        # a helper whose name declares it host-side (logging/summary/
+        # checkpoint helpers run between steps, not under trace).
+        pruned = {
+            qual: {
+                c
+                for c in callees
+                if not HostSyncRule._host_helper_name(_short_name(c))
+            }
+            for qual, callees in graph.edges.items()
+        }
+        roots = [
+            q
+            for q in graph.jit_entries
+            if not HostSyncRule._host_helper_name(_short_name(q))
+        ]
+        chains = dataflow.reach_with_chains(pruned, roots)
+        findings: List[Finding] = []
+        for qual in sorted(chains):
+            info = graph.functions.get(qual)
+            if info is None:
+                continue
+            ctx = proj.files[info.path]
+            chain = chains[qual]
+            via = (
+                " [call chain: %s]" % dataflow.render_chain(graph, chain)
+                if len(chain) > 1
+                else ""
+            )
+            for node in _scope_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if not (
+                    name in self._CLOCK_CALLS
+                    or (
+                        isinstance(node.func, ast.Name)
+                        and name in self._CLOCK_BARE
+                    )
+                ):
+                    continue
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "%s() in %r (reached from jitted %r) reads the "
+                        "wall clock at TRACE time — the value freezes "
+                        "into the compiled program; time outside traced "
+                        "code with an injected clock (observability."
+                        "spans) or use the profiler's device lanes%s"
+                        % (name, info.name, _short_name(chain[0]), via),
+                    )
+                )
+        return findings
+
+
 PERF_RULES: List[Rule] = [
     DtypePromotionRule(),
     LoopInvariantScanRule(),
     HostLoopTransferRule(),
+    WallClockOnTracedPathRule(),
 ]

@@ -67,6 +67,7 @@ from adanet_tpu.distributed.placement import (
 from adanet_tpu.ensemble.strategy import GrowStrategy
 from adanet_tpu.ensemble.weighted import ComplexityRegularizedEnsembler
 from adanet_tpu.observability import flightrec as flightrec_lib
+from adanet_tpu.observability import metrics as metrics_lib
 from adanet_tpu.observability import spans as spans_lib
 from adanet_tpu.robustness import faults as faults_lib
 from adanet_tpu.robustness import retry as retry_lib
@@ -258,6 +259,14 @@ class Estimator:
         ensemble_builder.py:571-583). The column is stripped before models
         see the features; weights feed every head loss and eval metric —
         training, Evaluator candidate scoring, and `evaluate`.
+      store_spec_extra: extra numeric-relevant configuration folded into
+        the store spec fingerprint (`store/keys.py::
+        search_spec_fingerprint`) that keys this search's `frozen/`
+        refs. The fleet (`adanet_tpu.fleet`) declares adanet
+        lambda/beta and the generator identity here so two trials
+        share frozen payloads iff they would train bit-identical
+        members — the cross-search graft-safety contract. Must be
+        JSON-able; validated at construction.
       keep_candidate_states: persist every candidate's final state when an
         iteration completes (`iteration-final-<t>.msgpack`, one per
         iteration), so `evaluate_all_candidates` keeps working after the
@@ -308,6 +317,7 @@ class Estimator:
         prefetch_buffer: int = 0,
         export_serving: bool = False,
         artifact_store=None,
+        store_spec_extra: Optional[Dict[str, Any]] = None,
     ):
         if max_iteration_steps is None or max_iteration_steps <= 0:
             raise ValueError(
@@ -400,6 +410,27 @@ class Estimator:
         self._elastic_batches = None
         self._speculation = None
 
+        # Extra numeric-relevant configuration folded into the store
+        # spec fingerprint (`store/keys.py::search_spec_fingerprint`).
+        # The fleet declares adanet lambda/beta and the generator
+        # identity here so two trials share frozen refs iff they train
+        # bit-identical members (cross-search graft safety).
+        if store_spec_extra is not None:
+            from adanet_tpu.store import keys as store_keys
+
+            # Fail at construction, not at the first publication (a
+            # search could train for hours before publishing): this
+            # validates both JSON-ability and base-key shadowing by
+            # running the real derivation once.
+            store_keys.search_spec_fingerprint(
+                self._random_seed,
+                self._max_iteration_steps,
+                dict(store_spec_extra),
+            )
+        self._store_spec_extra = (
+            dict(store_spec_extra) if store_spec_extra else None
+        )
+
         # Shared content-addressed artifact store (ROADMAP item 5):
         # compiled executables and frozen payloads published here are
         # reused by every search/serving process pointing at the same
@@ -415,6 +446,10 @@ class Estimator:
             )
         self._store_lease = None
         self._warned_replay_serving = False
+        # Iterations grafted from the store by THIS estimator (the
+        # fleet's per-trial transfer accounting; the registry counter
+        # `estimator.replay.store_grafts` carries the process total).
+        self._store_graft_count = 0
 
         # One executable cache for the whole search: iteration t+1's
         # structurally-identical programs (same-architecture candidates
@@ -655,9 +690,10 @@ class Estimator:
                     "peer_lost", extra={"error": str(self._peer_lost)}
                 )
             if coordination.is_chief():
-                # Search end: record the replay config (winner indices +
-                # architecture hashes per completed iteration) so this
-                # run is warm-startable without hand-constructing one.
+                # Search end: refresh the replay record once more (each
+                # completed iteration already wrote one incrementally;
+                # this covers resumed runs that completed no NEW
+                # iteration in this process).
                 self._write_replay_record()
         finally:
             if self._store_lease is not None:
@@ -1945,6 +1981,12 @@ class Estimator:
                 self._store_publish_iteration(t, info)
             ckpt_lib.write_manifest(self._model_dir, info)
             self._remove_state_file(stale_state)
+            # Refresh replay.json NOW, not only at search end: a
+            # SIGKILLed or fleet-culled search keeps a readable record
+            # of every completed iteration, so its progress stays
+            # graftable (the fleet's cross-search transfer path reads
+            # exactly these partial records).
+            self._write_replay_record()
             if self._export_serving:
                 self._publish_serving_generation(t, frozen, sample_batch)
         if self._summary is not None:
@@ -2390,16 +2432,17 @@ class Estimator:
     def _store_spec_fingerprint(self) -> str:
         """What makes numerically different frozen payloads under the
         SAME architecture: the base seed and the per-iteration step
-        budget. Two searches agreeing on both (and on the architecture
-        hash) train bit-identical members — the sharing contract."""
+        budget, plus any caller-declared `store_spec_extra` (the fleet
+        adds lambda/beta and the generator identity). Two searches
+        agreeing on all of it (and on the architecture hash) train
+        bit-identical members — the sharing contract."""
         from adanet_tpu.store import keys as store_keys
 
-        return store_keys.spec_fingerprint(
-            {
-                "random_seed": self._random_seed,
-                "max_iteration_steps": self._max_iteration_steps,
-            }
-        )[:16]
+        return store_keys.search_spec_fingerprint(
+            self._random_seed,
+            self._max_iteration_steps,
+            self._store_spec_extra,
+        )
 
     def _frozen_ref_name(self, arch_hash: str, t: int) -> str:
         """`frozen/<arch_hash>-t<iter>-<spec>`.
@@ -2595,6 +2638,10 @@ class Estimator:
         )
         ckpt_lib.write_manifest(self._model_dir, info)
         self._remove_state_file(stale_state)
+        # Same incremental contract as a trained iteration: the graft
+        # itself must be re-graftable by the next consumer even if this
+        # process dies before search end.
+        self._write_replay_record()
         self._store_lease_pin(sorted(set(blobs.values())))
         self._iteration_cache = None
         if self._export_serving and not self._warned_replay_serving:
@@ -2611,6 +2658,12 @@ class Estimator:
                 "search past the replayed prefix, to produce a "
                 "servable artifact."
             )
+        # The fleet's transfer accounting reads this: one count per
+        # iteration grafted from the shared store instead of trained.
+        self._store_graft_count += 1
+        metrics_lib.registry().counter(
+            "estimator.replay.store_grafts"
+        ).inc()
         _LOG.info(
             "Iteration %d warm-started from the artifact store "
             "(architecture %s): zero compiles, zero retraining.",
@@ -2620,8 +2673,17 @@ class Estimator:
         return True
 
     def _write_replay_record(self) -> None:
-        """Persists `replay.json` at search end (freshly derived, so a
-        resumed search never re-emits a stale record)."""
+        """Persists `replay.json` — freshly derived from the manifest
+        and architecture chain, so a resumed search never re-emits a
+        stale record. Called after EVERY completed iteration (and once
+        more at search end): an interrupted search must not lose the
+        graftable record of the iterations it did finish.
+
+        Deliberately re-derived from scratch each call (O(t) tiny-file
+        reads per iteration) rather than appended to the previous
+        record: the derivation is self-healing after an fsck rollback,
+        where appending would keep rolled-back iterations alive as
+        graft donors."""
         try:
             from adanet_tpu import replay as replay_lib
 

@@ -1361,16 +1361,32 @@ def drain_callables(
     devices=None,
     config: Optional[WorkQueueConfig] = None,
     kv=None,
-) -> None:
+    labels: Optional[List[str]] = None,
+    on_error: str = "raise",
+) -> Dict[str, BaseException]:
     """Runs an iterator of zero-arg callables (with barrier sentinels)
     through the lease-based queue on a thread pool.
 
-    The engine behind `experimental.ParallelScheduler` (now a thin shim):
-    units are claimed under leases in published order, each executing
-    with `jax.default_device` pinned to one device of the pool, and a
-    `None` sentinel in the stream is a BARRIER — all in-flight units
-    drain before later units publish (the phase-chaining contract).
-    Exceptions propagate to the caller after the drain, first one wins.
+    The engine behind `experimental.ParallelScheduler` (now a thin shim)
+    and the fleet controller's rung executor: units are claimed under
+    leases in published order, each executing with `jax.default_device`
+    pinned to one device of the pool, and a `None` sentinel in the
+    stream is a BARRIER — all in-flight units drain before later units
+    publish (the phase-chaining contract).
+
+    `labels` (aligned with the non-sentinel callables) name the units in
+    spans and in the returned error map; unlabeled units are named by
+    position. Labels should be unique — the error map is keyed by
+    label, so duplicate labels collapse to the LAST failure recorded
+    under that name. Failure policy is `on_error`:
+
+    - `"raise"` (the default, the historic contract): the first
+      exception aborts the remaining units of the phase and re-raises
+      after the drain.
+    - `"isolate"`: a failing unit is recorded and the OTHER units keep
+      running — its freed worker slot immediately claims the next unit
+      (the fleet needs this: one dead trial must not abort a rung).
+      The collected `{label: exception}` map is returned.
 
     In-process threads cannot die independently of the process — every
     callable either completes or raises, and both paths publish the
@@ -1380,6 +1396,10 @@ def drain_callables(
     it after `max_attempts`, failure modes the cross-process queue needs
     and a same-process pool does not.
     """
+    if on_error not in ("raise", "isolate"):
+        raise ValueError(
+            "on_error must be 'raise' or 'isolate', got %r" % (on_error,)
+        )
     config = config or WorkQueueConfig()
     config = dataclasses.replace(
         config,
@@ -1387,12 +1407,16 @@ def drain_callables(
     )
     kv = kv or InMemoryKV()
     devices = list(devices) if devices is not None else jax.devices()
+    labels = list(labels) if labels is not None else None
     errors: List[BaseException] = []
+    failures: Dict[str, BaseException] = {}
     error_lock = threading.Lock()
 
     phase = [0]
 
-    def run_phase(callables: List[Callable[[], None]]) -> None:
+    def run_phase(
+        callables: List[Callable[[], None]], names: List[str]
+    ) -> None:
         if not callables:
             return
         phase[0] += 1
@@ -1419,9 +1443,10 @@ def drain_callables(
             wq_local.attach(wq.units)
             device = devices[worker_index % len(devices)]
             while True:
-                with error_lock:
-                    if errors:
-                        return
+                if on_error == "raise":
+                    with error_lock:
+                        if errors:
+                            return
                 claim = wq_local.claim(lambda u: True, lambda u: True)
                 if claim is None:
                     if wq_local.drained():
@@ -1433,12 +1458,18 @@ def drain_callables(
                 try:
                     with LeaseRenewer(wq_local, unit, attempt):
                         with jax.default_device(device):
-                            callables[index]()
+                            with spans_lib.tracer().span(
+                                "callable_unit", unit=names[index]
+                            ):
+                                callables[index]()
                 except BaseException as exc:  # surfaced after the drain
                     with error_lock:
                         errors.append(exc)
+                        failures[names[index]] = exc
                     wq_local.complete(unit, attempt, None)
-                    return
+                    if on_error == "raise":
+                        return
+                    continue
                 wq_local.complete(unit, attempt, None)
 
         threads = [
@@ -1454,14 +1485,24 @@ def drain_callables(
             # first publishing its unit's done/ marker.
             while thread.is_alive():
                 thread.join(timeout=60.0)
-        if errors:
+        if errors and on_error == "raise":
             raise errors[0]
 
+    def unit_name(index: int) -> str:
+        if labels is not None and index < len(labels):
+            return str(labels[index])
+        return "unit%d" % index
+
     batch: List[Callable[[], None]] = []
+    names: List[str] = []
+    cursor = 0
     for item in make_units:
         if item is None:  # barrier
-            run_phase(batch)
-            batch = []
+            run_phase(batch, names)
+            batch, names = [], []
             continue
         batch.append(item)
-    run_phase(batch)
+        names.append(unit_name(cursor))
+        cursor += 1
+    run_phase(batch, names)
+    return dict(failures)

@@ -1,0 +1,51 @@
+"""Fleet search: a population of AdaNet searches over one shared store.
+
+ROADMAP item "fleet-scale search". PR 6's elastic work-queue scheduler
+plus PR 8's zero-compile/zero-retrain warm starts make running MANY
+searches nearly free; this package orchestrates them:
+
+- `trial` — `TrialSpec`: one hyperparameter configuration (adanet
+  lambda/beta, generator/search-space identity, seed, step budget) with
+  a deterministic spec fingerprint feeding `store/keys.py`, so
+  cross-trial artifact reuse is safe by construction.
+- `controller` — `FleetController`: the population state machine.
+  Successive-halving rungs at iteration boundaries; trials run as
+  leased work units on the PR 6 callable queue, culled trials release
+  their capacity back to the queue and survivors immediately re-pack
+  onto it; crash-safe durable state (`fleet.json`) with SIGKILL-anywhere
+  resume (the `fleet.promote` fault site).
+- `comparator` — cross-trial ranking by the complexity-regularized
+  AdaNet objective F(w) on one shared eval stream, tie-breaking toward
+  smaller ensembles.
+- `transfer` — cross-search member grafting: survivors (and the final
+  champion rebuild) import proven frozen members from sibling or culled
+  trials through `adanet_tpu.replay` and the store's (architecture,
+  iteration, spec, env) frozen refs — zero retraining, zero XLA
+  compiles on graft (the `fleet.graft` fault site).
+
+CLI: `tools/fleetctl.py` (launch / status / report). Docs:
+docs/fleet.md.
+"""
+
+from adanet_tpu.fleet.comparator import Comparator, Score, rank
+from adanet_tpu.fleet.controller import (
+    FleetController,
+    FleetReport,
+    TrialRecord,
+    load_status,
+)
+from adanet_tpu.fleet.transfer import GraftPlan, plan_graft
+from adanet_tpu.fleet.trial import TrialSpec
+
+__all__ = [
+    "Comparator",
+    "FleetController",
+    "FleetReport",
+    "GraftPlan",
+    "Score",
+    "TrialRecord",
+    "TrialSpec",
+    "load_status",
+    "plan_graft",
+    "rank",
+]

@@ -1,0 +1,225 @@
+"""Cross-trial ranking by the complexity-regularized objective F(w).
+
+The AdaNet objective
+
+    F(w) = (1/m) sum_i Phi(sum_j w_j h_j(x_i), y_i)
+           + sum_j (lambda * r(h_j) + beta) |w_j|_1
+
+is a principled comparator not just within one search but ACROSS
+searches with different lambda/beta, generators, and budgets (PAPER.md
+§"What AdaNet is"): the loss term is measured on one shared held-out
+set, and the penalty term prices each trial's ensemble by the same
+capacity yardstick. Two modes:
+
+- **uniform** (`adanet_lambda`/`adanet_beta` given): the penalty is
+  recomputed from every trial's mixture weights and member complexities
+  under the COMPARATOR's lambda/beta, so a lambda=0 trial cannot win
+  merely by reporting a zero penalty for a huge ensemble.
+- **own-objective** (both None): each ensemble's recorded
+  `complexity_regularization` (its own lambda/beta) is used — the
+  "which search achieved its own objective best" question.
+
+Ties break toward smaller ensembles (fewer members), then by trial id,
+so equal-loss trials prefer the cheaper model and ranking is total and
+deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from adanet_tpu.core import iteration as iteration_lib
+from adanet_tpu.core.compile_cache import CachedStep
+from adanet_tpu.utils.batches import batch_metric_weight
+
+
+@dataclasses.dataclass(frozen=True)
+class Score:
+    """One trial's comparator result (lower objective is better)."""
+
+    trial_id: str
+    objective: float  # loss + complexity_regularization
+    loss: float  # weighted mean head loss on the eval set
+    complexity_regularization: float
+    num_members: int
+    iterations: int
+    global_step: int
+
+    def sort_key(self):
+        """Total order, best first: finite before non-finite, then
+        objective, then FEWER members (the complexity tie-break), then
+        trial id for determinism."""
+        finite = math.isfinite(self.objective)
+        return (
+            0 if finite else 1,
+            self.objective if finite else 0.0,
+            self.num_members,
+            self.trial_id,
+        )
+
+    def to_json(self) -> dict:
+        def _finite(value):
+            return float(value) if math.isfinite(value) else None
+
+        return {
+            "trial_id": self.trial_id,
+            "objective": _finite(self.objective),
+            "loss": _finite(self.loss),
+            "complexity_regularization": _finite(
+                self.complexity_regularization
+            ),
+            "num_members": int(self.num_members),
+            "iterations": int(self.iterations),
+            "global_step": int(self.global_step),
+        }
+
+
+def rank(scores: Sequence[Score]) -> List[Score]:
+    """Best-first ordering under `Score.sort_key`."""
+    return sorted(scores, key=lambda s: s.sort_key())
+
+
+class Comparator:
+    """Scores a trial's current best ensemble on a shared eval stream.
+
+    Args:
+      eval_input_fn: zero-arg callable yielding (features, labels)
+        batches — the SHARED held-out set every trial is scored on.
+      eval_steps: batches per scoring pass (the stream may be infinite).
+      adanet_lambda / adanet_beta: uniform-mode penalty strengths; both
+        None selects own-objective mode (see module docstring).
+    """
+
+    def __init__(
+        self,
+        eval_input_fn,
+        eval_steps: int = 8,
+        adanet_lambda: Optional[float] = None,
+        adanet_beta: Optional[float] = None,
+    ):
+        if eval_steps <= 0:
+            raise ValueError("eval_steps must be positive.")
+        if (adanet_lambda is None) != (adanet_beta is None):
+            raise ValueError(
+                "Set both of adanet_lambda/adanet_beta (uniform mode) "
+                "or neither (own-objective mode)."
+            )
+        self._eval_input_fn = eval_input_fn
+        self._eval_steps = int(eval_steps)
+        self._adanet_lambda = (
+            None if adanet_lambda is None else float(adanet_lambda)
+        )
+        self._adanet_beta = (
+            None if adanet_beta is None else float(adanet_beta)
+        )
+
+    # ------------------------------------------------------------- penalty
+
+    def _penalty(self, ensemble) -> Any:
+        """The regularization term, traced inside the stats program."""
+        members = getattr(ensemble, "weighted_subnetworks", None)
+        if self._adanet_lambda is not None and members:
+            total = jnp.float32(0.0)
+            for ws in members:
+                l1 = sum(
+                    jnp.sum(jnp.abs(leaf))
+                    for leaf in jax.tree_util.tree_leaves(ws.weight)
+                )
+                gamma = (
+                    self._adanet_lambda
+                    * jnp.asarray(ws.subnetwork.complexity, jnp.float32)
+                    + self._adanet_beta
+                )
+                total = total + gamma * l1
+            return total
+        recorded = getattr(ensemble, "complexity_regularization", None)
+        if recorded is None:
+            return jnp.float32(0.0)
+        return jnp.asarray(recorded, jnp.float32)
+
+    # ------------------------------------------------------------- scoring
+
+    def score(self, estimator, trial_id: str) -> Score:
+        """F(w) of `estimator`'s current best ensemble.
+
+        Compilation rides the estimator's `CompileCache`, so the scoring
+        program is compiled once per structure and — with a shared
+        artifact store attached — once per structure per FLEET.
+        """
+        first, data = estimator._bootstrap_input(self._eval_input_fn)
+        forward, params, _name = estimator._final_forward_fn(first)
+        head = estimator._head
+        weight_key = estimator._weight_key
+
+        def stats_fn(p, features, labels):
+            features, weights = iteration_lib.split_example_weights(
+                features, weight_key
+            )
+            ensemble = forward(p, features)
+            loss = head.loss(ensemble.logits, labels, weights)
+            return (
+                jnp.asarray(loss, jnp.float32),
+                self._penalty(ensemble),
+            )
+
+        step = CachedStep(stats_fn, estimator._compile_cache)
+        # Stage per-batch scalars and fetch once after the loop: one
+        # device_get per scoring pass, not per batch (jaxlint JL012).
+        staged = []
+        sizes = []
+        for _step, batch in zip(range(self._eval_steps), data):
+            features, labels = batch
+            sizes.append(batch_metric_weight(batch, weight_key))
+            staged.append(step(params, features, labels))
+        host = jax.device_get(staged)
+        total = sum(sizes) or 1.0
+        loss = sum(
+            float(value) * size
+            for (value, _), size in zip(host, sizes)
+        ) / total
+        # The penalty is a pure function of the params — identical on
+        # every batch; take the first.
+        penalty = float(host[0][1])
+        num_members, iterations, global_step = _architecture_facts(
+            estimator
+        )
+        return Score(
+            trial_id=str(trial_id),
+            objective=loss + penalty,
+            loss=loss,
+            complexity_regularization=penalty,
+            num_members=num_members,
+            iterations=iterations,
+            global_step=global_step,
+        )
+
+def _architecture_facts(estimator):
+    """(num_members, completed iterations, global step) from the
+    durable record — host-side facts for tie-breaking and reporting."""
+    import json
+    import os
+
+    from adanet_tpu.core import checkpoint as ckpt_lib
+
+    info = ckpt_lib.read_manifest(estimator.model_dir)
+    if info is None or info.iteration_number == 0:
+        return 0, 0, 0
+    t = info.iteration_number - 1
+    path = os.path.join(
+        estimator.model_dir, ckpt_lib.architecture_filename(t)
+    )
+    try:
+        with open(path) as f:
+            arch = json.load(f)
+        members = len(arch.get("subnetworks", []))
+    except (OSError, ValueError):
+        members = 0
+    return members, info.iteration_number, int(info.global_step)
+
+
+__all__ = ["Comparator", "Score", "rank"]

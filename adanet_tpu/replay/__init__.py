@@ -18,8 +18,12 @@ the resulting winner architecture (`store.keys.architecture_hash`).
   tests/test_store.py).
 
 `Estimator.train` writes `replay.json` (`REPLAY_FILENAME`) into the
-model dir at search end, so every finished search is replayable without
-hand-constructing a `Config`.
+model dir after every completed iteration (and once more at search
+end), so every search — finished, interrupted, or fleet-culled — is
+replayable up to its last completed iteration without hand-constructing
+a `Config`. `load_partial` is the tolerant read side of that contract:
+the fleet's cross-search transfer (`adanet_tpu.fleet.transfer`) grafts
+from whatever prefix a sibling or culled search managed to record.
 """
 
 from __future__ import annotations
@@ -156,4 +160,20 @@ class Config:
         )
 
 
-__all__ = ["Config", "REPLAY_FILENAME"]
+def load_partial(model_dir: str) -> Config:
+    """Best-effort replay config for a possibly-unfinished model dir.
+
+    Reads the recorded `replay.json` when present (written incrementally
+    per completed iteration), falls back to deriving from the checkpoint
+    manifest, and returns an EMPTY config — never raises — when the dir
+    is missing, empty, or too damaged to derive from. Donor selection in
+    the fleet's transfer path runs over many sibling/culled dirs; one
+    unreadable donor must not break grafting from the others.
+    """
+    try:
+        return Config.from_model_dir(model_dir)
+    except Exception:
+        return Config()
+
+
+__all__ = ["Config", "REPLAY_FILENAME", "load_partial"]

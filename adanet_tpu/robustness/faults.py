@@ -65,6 +65,8 @@ FAULT_SITES = frozenset(
         "store.get",  # store/blobstore.py blob read entry
         "store.gc",  # store/gc.py collection entry
         "flightrec.dump",  # observability/flightrec.py stage->rename seam
+        "fleet.promote",  # fleet/controller.py rung promotion entry
+        "fleet.graft",  # fleet/transfer.py cross-search graft planning
     }
 )
 

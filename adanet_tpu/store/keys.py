@@ -50,6 +50,39 @@ def spec_fingerprint(spec: Dict[str, Any]) -> str:
     return sha256_hex(canonical_json(spec))
 
 
+#: Length of the short spec fingerprint embedded in ref names.
+SEARCH_SPEC_FINGERPRINT_LEN = 16
+
+
+def search_spec_fingerprint(
+    random_seed: int,
+    max_iteration_steps: int,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """The short spec fingerprint a SEARCH keys its frozen refs by.
+
+    One derivation shared by `Estimator._store_spec_fingerprint` and
+    `fleet.TrialSpec.spec_fingerprint`, so "two searches share frozen
+    payloads iff their fingerprints agree" is safe by construction: the
+    base ingredients (seed, per-iteration step budget) plus whatever
+    `extra` numeric-relevant configuration the caller declares (the
+    fleet adds adanet lambda/beta and the generator identity — anything
+    that makes the SAME architecture train to DIFFERENT numbers).
+    `extra` keys may not shadow the base keys.
+    """
+    spec: Dict[str, Any] = {
+        "random_seed": int(random_seed),
+        "max_iteration_steps": int(max_iteration_steps),
+    }
+    for key, value in sorted((extra or {}).items()):
+        if key in spec:
+            raise ValueError(
+                "spec extra key %r shadows a base spec ingredient" % key
+            )
+        spec[key] = value
+    return spec_fingerprint(spec)[:SEARCH_SPEC_FINGERPRINT_LEN]
+
+
 _env_fp_cache: Optional[str] = None
 
 

@@ -790,6 +790,189 @@ def _warm_start_section():
         }
 
 
+def _measure_fleet_search():
+    """Fleet-of-searches vs the best single search at EQUAL total step
+    budget (the fleet ROADMAP gate).
+
+    A 4-trial fleet over one shared artifact store — trials vary the
+    complexity-regularization strengths (lambda, beta) of the same
+    simple_dnn search space — runs successive halving (rungs 1 -> 2
+    iterations, half culled at the boundary) and rebuilds its winner as
+    a store-grafted champion. The baseline is the A-PRIORI single
+    search (the conservative heavily-regularized config an operator
+    would launch without a fleet) trained for the fleet's TOTAL trained
+    step budget. Both are scored by one uniform comparator F(w) =
+    eval loss + sum_j (lambda_c r(h_j) + beta_c)|w_j|_1.
+
+    Host+store+CPU-servable machinery throughout, so the accounting is
+    real on the `tpu_unavailable` path too.
+    """
+    import shutil
+    import tempfile
+
+    import adanet_tpu
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.examples import simple_dnn
+    from adanet_tpu.fleet import Comparator, FleetController, TrialSpec
+
+    root = tempfile.mkdtemp(prefix="adanet_fleet_")
+    rng = np.random.RandomState(0)
+    features = rng.randn(512, 8).astype(np.float32)
+    weights = rng.randn(8, 1).astype(np.float32)
+    labels = features @ weights
+
+    def input_fn():
+        i = 0
+        while True:
+            lo = (i * 64) % 512
+            yield features[lo : lo + 64], labels[lo : lo + 64]
+            i += 1
+
+    def make_generator():
+        return simple_dnn.Generator(
+            optimizer_fn=lambda: optax.sgd(0.02), layer_size=16
+        )
+
+    steps_per_iteration = 8
+    baseline_lambda, baseline_beta = 2.0, 0.5
+
+    def trial(trial_id, adanet_lambda, adanet_beta):
+        return TrialSpec(
+            trial_id=trial_id,
+            make_head=adanet_tpu.RegressionHead,
+            make_generator=make_generator,
+            generator_id="simple_dnn/layer_size=16/lr=0.02",
+            max_iteration_steps=steps_per_iteration,
+            random_seed=1,
+            adanet_lambda=adanet_lambda,
+            adanet_beta=adanet_beta,
+            make_ensembler_optimizer=lambda: optax.sgd(0.05),
+        )
+
+    trials = [
+        # The a-priori "safe" config doubles as the baseline below.
+        trial("lam_hi", baseline_lambda, baseline_beta),
+        trial("lam_mid", 0.1, 0.01),
+        trial("lam_lo", 0.0, 0.0),
+        trial("lam_tiny", 0.01, 0.001),
+    ]
+    comparator = Comparator(
+        input_fn,
+        eval_steps=8,
+        adanet_lambda=0.01,
+        adanet_beta=0.001,
+    )
+    try:
+        start = time.perf_counter()
+        controller = FleetController(
+            trials,
+            input_fn,
+            work_dir=os.path.join(root, "fleet"),
+            rung_iterations=(1, 2),
+            survivor_fraction=0.5,
+            comparator=comparator,
+            workers=1,
+        )
+        report = controller.run()
+        fleet_wall = time.perf_counter() - start
+
+        # The baseline single search at the fleet's TOTAL trained
+        # budget (successive halving spends 4+2 iterations here).
+        budget_iterations = report.total_steps_trained // steps_per_iteration
+        start = time.perf_counter()
+        single = adanet_tpu.Estimator(
+            head=adanet_tpu.RegressionHead(),
+            subnetwork_generator=make_generator(),
+            max_iteration_steps=steps_per_iteration,
+            ensemblers=[
+                ComplexityRegularizedEnsembler(
+                    optimizer=optax.sgd(0.05),
+                    adanet_lambda=baseline_lambda,
+                    adanet_beta=baseline_beta,
+                )
+            ],
+            max_iterations=budget_iterations,
+            model_dir=os.path.join(root, "single"),
+            random_seed=1,
+            log_every_steps=0,
+        )
+        single.train(input_fn)
+        single_wall = time.perf_counter() - start
+        single_score = comparator.score(single, "single_baseline")
+
+        from adanet_tpu.store import fsck_store
+
+        audit = fsck_store(controller.store)
+        winner = report.winner_score
+        return {
+            "trials": {
+                trial_id: {
+                    "state": entry["state"],
+                    "iterations": entry["iterations"],
+                    "steps_trained": entry["steps_trained"],
+                    "objective": (entry["score"] or {}).get("objective"),
+                }
+                for trial_id, entry in report.trials.items()
+            },
+            "fleet": {
+                "wall_secs": round(fleet_wall, 3),
+                "winner": report.winner_id,
+                "objective": winner.objective if winner else None,
+                "total_steps_trained": report.total_steps_trained,
+                "graft_attempts": report.graft_attempts,
+                "graft_hits": report.graft_hits,
+                "compile_store_hits": report.compile_store_hits,
+            },
+            "single_search": {
+                "wall_secs": round(single_wall, 3),
+                "config": "lam_hi (the a-priori baseline)",
+                "objective": single_score.objective,
+                "steps_trained": int(single.latest_global_step()),
+                "iterations": budget_iterations,
+            },
+            # The ROADMAP gate, as machine-checkable verdicts: the
+            # fleet's final ensemble objective at equal total budget,
+            # and >=1 cross-trial store hit (the champion rebuild
+            # grafts the winner's frozen payloads — zero retraining).
+            "equal_budget": (
+                int(single.latest_global_step())
+                == report.total_steps_trained
+            ),
+            "fleet_beats_single": bool(
+                winner is not None
+                and winner.objective <= single_score.objective
+            ),
+            "cross_trial_store_hits": report.graft_hits,
+            "store": {
+                "blob_count": audit["blob_count"],
+                "bytes": audit["bytes"],
+                "ref_count": audit["ref_count"],
+                "clean": audit["clean"],
+            },
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _fleet_search_section():
+    """`fleet_search` with the structured-skip contract of every section.
+
+    `ADANET_BENCH_FLEET=0` opts out (tier-1's bench-contract test: the
+    fleet gate already runs in-process in tests/test_fleet.py, and the
+    RUN_SLOW gate runs this section directly — the subprocess contract
+    check need not pay for a third fleet).
+    """
+    if os.environ.get("ADANET_BENCH_FLEET") == "0":
+        return {"skipped": "fleet_bench_disabled_by_env"}
+    try:
+        return _measure_fleet_search()
+    except Exception as exc:
+        return {
+            "skipped": "fleet_search_bench_failed",
+            "error": "%s: %s" % (type(exc).__name__, exc),
+        }
+
+
 def _probe_cache_path():
     import hashlib
 
@@ -924,6 +1107,9 @@ def _emit_unavailable_record():
         # Warm starts are host+store machinery; the accounting is real
         # on CPU (first numbers: BENCH_warmstart_r01.json).
         "warm_start": _warm_start_section(),
+        # Fleet-of-searches vs best single search at equal total step
+        # budget (host+store machinery, CPU-runnable).
+        "fleet_search": _fleet_search_section(),
         # Per-component step attribution stays meaningful on CPU (the
         # components exist on every backend; step_clock says host).
         "roofline": _roofline_section(
@@ -1063,6 +1249,9 @@ def main():
         # Compile-cache hit/miss accounting across two separate search
         # runs sharing one content-addressed artifact store.
         "warm_start": _warm_start_section(),
+        # A 4-trial successive-halving fleet vs the a-priori single
+        # search at equal total step budget over one shared store.
+        "fleet_search": _fleet_search_section(),
         # Per-component attribution of the flagship NASNet step
         # (compile / input-pull / device-step / host-fetch) — the
         # breakdown the MFU campaign attacks component by component.

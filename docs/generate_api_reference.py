@@ -35,6 +35,7 @@ API_MODULES = [
     "adanet_tpu.ensemble",
     "adanet_tpu.autoensemble",
     "adanet_tpu.distributed",
+    "adanet_tpu.fleet",
     "adanet_tpu.observability",
     "adanet_tpu.replay",
     "adanet_tpu.robustness",

@@ -186,6 +186,10 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     env["ADANET_BENCH_FORCE_UNAVAILABLE"] = "1"
     # Let bench.py pick its own topology-keyed cache dir (see above).
     env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    # The fleet gate runs in-process in test_fleet.py (tiny) and under
+    # RUN_SLOW (full); the contract check only asserts the section's
+    # structured opt-out so tier-1 doesn't pay for a third fleet run.
+    env["ADANET_BENCH_FLEET"] = "0"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py")],
         cwd=repo,
@@ -211,6 +215,12 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     assert serving["p50_ms"] > 0 and serving["p99_ms"] >= serving["p50_ms"]
     assert serving["qps"] > 0
     assert serving["error"] == 0, serving
+    # The fleet section honored the structured opt-out (the real gate
+    # runs in test_fleet.py / RUN_SLOW; BENCH_fleet_r01.json carries
+    # the recorded numbers).
+    assert result["fleet_search"] == {
+        "skipped": "fleet_bench_disabled_by_env"
+    }
     # The warm-start section is host+store machinery: real numbers on
     # the outage path too.
     warm = result["warm_start"]

@@ -324,6 +324,37 @@ def test_release_reissues_immediately():
     assert (unit2.uid, attempt2) == (unit.uid, 1)
 
 
+def test_drain_callables_isolates_failures_by_label():
+    """`on_error="isolate"`: a failing unit is recorded under its label
+    and the OTHER units still run (the fleet's one-dead-trial-must-not-
+    abort-the-rung contract); `"raise"` keeps the historic first-error
+    behavior."""
+    from adanet_tpu.distributed.scheduler import drain_callables
+
+    ran = []
+
+    def ok(name):
+        return lambda: ran.append(name)
+
+    def boom():
+        raise RuntimeError("unit death")
+
+    failures = drain_callables(
+        [ok("a"), boom, ok("c")],
+        num_workers=1,
+        labels=["trial_a", "trial_b", "trial_c"],
+        on_error="isolate",
+    )
+    assert ran == ["a", "c"]
+    assert set(failures) == {"trial_b"}
+    assert isinstance(failures["trial_b"], RuntimeError)
+
+    with pytest.raises(RuntimeError, match="unit death"):
+        drain_callables([boom, ok("late")], num_workers=1)
+    with pytest.raises(ValueError):
+        drain_callables([], num_workers=1, on_error="bogus")
+
+
 def test_encode_decode_tree_roundtrip():
     tree = {
         "w": np.arange(12, dtype=np.float32).reshape(3, 4),

@@ -890,6 +890,14 @@ class HostModuleJnpRule(Rule):
         "serving/frontend.py",
         "serving/model_pool.py",
         "serving/publisher.py",
+        # The fleet's policy layer (trial specs, rung state machine,
+        # graft planning) runs between searches; only
+        # fleet/comparator.py traces device programs.
+        "fleet/__init__.py",
+        "fleet/controller.py",
+        "fleet/transfer.py",
+        "fleet/trial.py",
+        "tools/fleetctl.py",
         # The artifact store is pure host I/O (digests, renames,
         # leases, GC) — the accelerator never appears on its data path.
         "store/__init__.py",

@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import logging
 import os
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -32,6 +32,9 @@ _LOG = logging.getLogger("adanet_tpu")
 
 SERVING_FILE = "serving.stablehlo"
 SIGNATURE_FILE = "serving_signature.json"
+#: The cheap-member program of a cascade publication
+#: (`serving.fleet.cascade`): same serialization, second file.
+CASCADE_FILE = "cascade.stablehlo"
 
 
 DEFAULT_PLATFORMS = ("cpu", "tpu")
@@ -178,12 +181,18 @@ def export_serving_program(
     return path
 
 
-def load_serving_program(export_dir: str) -> Callable:
+def load_serving_program(
+    export_dir: str, filename: Optional[str] = None
+) -> Callable:
     """Loads a serialized ensemble; returns `fn(features) -> predictions`.
 
-    Needs only jax — no generator, builders, or model code.
+    Needs only jax — no generator, builders, or model code. `filename`
+    selects an alternate program in the same export (the cascade's
+    cheap member, `CASCADE_FILE`); default is the full ensemble.
     """
-    with open(os.path.join(export_dir, SERVING_FILE), "rb") as f:
+    with open(
+        os.path.join(export_dir, filename or SERVING_FILE), "rb"
+    ) as f:
         exported = jax_export.deserialize(f.read())
     return exported.call
 

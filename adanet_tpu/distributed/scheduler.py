@@ -229,6 +229,129 @@ class CoordinationKV:
             pass
 
 
+class FileKV:
+    """The coordination surface over a shared directory.
+
+    Serves consumers that span PROCESSES but not a jax distributed
+    runtime — the serving fleet's replicas and balancer
+    (`serving/fleet/`) coordinate through one of these without paying
+    for (or depending on) a coordination service. Semantics match the
+    other two stores:
+
+    - `set(overwrite=False)` is atomic insert-if-absent: the value is
+      staged in a hidden temp file and `os.link`ed to the final name,
+      the same set-once claim idiom as the artifact store's refs (one
+      syscall either creates the complete file or fails EEXIST — a
+      reader can never observe a torn set-once value, and two racing
+      writers get exactly one winner).
+    - `set(overwrite=True)` is stage + `os.replace` (atomic, last
+      writer wins) — heartbeat records.
+    - every `get` is bounded by `timeout_secs` (jaxlint JL009); the
+      wait is a poll, sized for the fleet's human-scale key rates.
+
+    Keys are arbitrary strings; they map to flat filenames via
+    URL-style percent-encoding (UTF-8 byte-wise — `urllib.parse.quote`
+    with nothing extra in `safe`, so `/` escapes too), so
+    `scan(prefix)` is a directory listing plus a decoded prefix
+    filter.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _encode_key(key: str) -> str:
+        import urllib.parse
+
+        return urllib.parse.quote(key, safe="")
+
+    @staticmethod
+    def _decode_key(name: str) -> str:
+        import urllib.parse
+
+        return urllib.parse.unquote(name)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, self._encode_key(key))
+
+    def _stage(self, value) -> str:
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            self._counter += 1
+            n = self._counter
+        tmp = os.path.join(
+            self.root, ".tmp-%d-%d" % (os.getpid(), n)
+        )
+        with open(tmp, "wb") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        return tmp
+
+    def set(self, key: str, value, overwrite: bool = True) -> bool:
+        tmp = self._stage(value)
+        try:
+            if overwrite:
+                os.replace(tmp, self._path(key))
+                return True
+            try:
+                os.link(tmp, self._path(key))
+                return True
+            except FileExistsError:
+                return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def get(self, key: str, timeout_secs: float) -> bytes:
+        deadline = time.monotonic() + timeout_secs
+        while True:
+            value = self.try_get(key)
+            if value is not None:
+                return value
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "key %r not set within %.1fs" % (key, timeout_secs)
+                )
+            time.sleep(min(0.02, max(0.0, deadline - time.monotonic())))
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def scan(self, prefix: str) -> Dict[str, bytes]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return {}
+        out: Dict[str, bytes] = {}
+        for name in names:
+            if name.startswith(".tmp-"):
+                continue
+            key = self._decode_key(name)
+            if not key.startswith(prefix):
+                continue
+            value = self.try_get(key)
+            if value is not None:
+                out[key] = value
+        return out
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+
 def coordination_kv():
     """The live coordination-service KV, or None single-process."""
     from jax._src import distributed

@@ -61,6 +61,8 @@ FAULT_SITES = frozenset(
         "serving.flip",  # serving/model_pool.py generation flip entry
         "serving.model_load",  # serving/model_pool.py program deserialize
         "serving.batch_execute",  # serving/batcher.py padded-batch dispatch
+        "serving.replica_heartbeat",  # serving/fleet/replica.py watermark publish
+        "serving.fleet_flip",  # serving/fleet/flip_coordinator.py flip participation
         "store.put",  # store/blobstore.py blob publication (post-write)
         "store.get",  # store/blobstore.py blob read entry
         "store.gc",  # store/gc.py collection entry

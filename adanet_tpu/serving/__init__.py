@@ -15,6 +15,10 @@ This package turns that invariant into a serving system (ROADMAP item
   donated-buffer inference, canary mirroring.
 - `frontend` — bounded queue, watermark load shedding with hysteresis,
   per-request deadline budgets, SIGTERM drain.
+- `fleet` (subpackage, imported on demand) — the replicated serving
+  plane: N replica processes, a watermark-balanced front tier,
+  coordinated all-or-none fleet flips, and cascaded ensemble
+  inference. See `adanet_tpu/serving/fleet/__init__.py`.
 
 Minimal server:
 

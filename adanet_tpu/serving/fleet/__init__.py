@@ -1,0 +1,63 @@
+"""Replicated serving plane: N replicas, one front tier, one flip.
+
+ROADMAP item 2. The single-process serving chain
+(`serving/frontend.py` -> `batcher.py` -> `model_pool.py`) scales out
+by replication over primitives earlier PRs built: lease-pinned store
+refs make the generation chain multi-reader, the coordination KV's
+set-once claims give fleet-wide agreement, and the frontend's typed
+watermark snapshot is the backpressure signal. The pieces:
+
+- `replica` — one serving process: bootstraps its generation closure
+  from the shared chain/store, runs the existing frontend chain, and
+  publishes heartbeat watermarks on the KV.
+- `balancer` — the front tier: power-of-two-choices over
+  depth+latency scores, hysteretic exclusion of shedding/stale
+  replicas, deadline-aware retry-on-other-replica.
+- `flip_coordinator` — coordinated fleet-wide generation flips: one
+  replica canaries, then an all-or-none set-once commit; a replica
+  SIGKILLed mid-flip completes at respawn or the fleet rolls back.
+- `cascade` — cascaded ensemble inference: answer from the cheapest
+  member when its calibrated confidence clears the published margin,
+  fall through (bit-identically) to the full ensemble otherwise.
+- `transport` — the co-located wire protocol (framed numpy trees over
+  unix sockets; no pickle).
+
+Operator surface: `tools/servectl.py` (launch/status/drain). See
+docs/serving.md's "Replicated fleet" section for the balancer policy
+and the flip state machine.
+"""
+
+from adanet_tpu.serving.fleet.balancer import (
+    BalancerConfig,
+    FleetBalancer,
+)
+from adanet_tpu.serving.fleet.cascade import CascadeSpec, calibrate
+from adanet_tpu.serving.fleet.flip_coordinator import (
+    FlipConfig,
+    FlipParticipant,
+    bootstrap_generation,
+)
+from adanet_tpu.serving.fleet.replica import (
+    NAMESPACE,
+    ReplicaConfig,
+    ServingReplica,
+    fresh_replica_ids,
+    publish_heartbeat,
+    read_heartbeats,
+)
+
+__all__ = [
+    "BalancerConfig",
+    "CascadeSpec",
+    "FleetBalancer",
+    "FlipConfig",
+    "FlipParticipant",
+    "NAMESPACE",
+    "ReplicaConfig",
+    "ServingReplica",
+    "bootstrap_generation",
+    "calibrate",
+    "fresh_replica_ids",
+    "publish_heartbeat",
+    "read_heartbeats",
+]

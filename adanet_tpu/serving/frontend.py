@@ -97,6 +97,9 @@ class ServeResult:
     generation: Optional[int] = None
     retry_after: Optional[float] = None
     error: Optional[str] = None
+    #: Which cascade tier answered: 0 = cheap member, 1 = full
+    #: ensemble, None = the generation has no cascade.
+    cascade_level: Optional[int] = None
 
     @property
     def ok(self) -> bool:
@@ -414,17 +417,42 @@ class ServingFrontend:
         return request.wait(timeout)
 
     def stats(self) -> Dict[str, Any]:
+        """Machine-readable watermark snapshot (the replica-balancer
+        heartbeat payload).
+
+        Typed fields: `ts_monotonic` (this frontend's monotonic clock
+        at snapshot time), `generation` (the incumbent's iteration
+        number, None before the first flip), the backpressure
+        watermarks (`queue_depth`, `wait_ewma_secs`, `exec_ewma_secs`,
+        `shedding`, `draining`), and the per-status census under
+        `statuses`. The pre-fleet mixed debug fields (bare status
+        counts at the top level, `pool_*` keys) are kept as ALIASES
+        for one release — new consumers read the typed fields only.
+        """
         with self._cond:
             depth = len(self._queue)
-        out = dict(self.counters)
+        active = self.pool.active
+        out: Dict[str, Any] = {
+            "ts_monotonic": self._clock(),
+            "generation": (
+                active.iteration_number if active is not None else None
+            ),
+            "queue_depth": depth,
+            "wait_ewma_secs": self.admission.wait_ewma,
+            "exec_ewma_secs": self.budget.estimate,
+            "shedding": self.admission.shedding,
+            "draining": self._draining,
+            "statuses": dict(self.counters),
+        }
+        # Deprecated aliases (one release): bare status counts and the
+        # pool's stats with a `pool_` prefix, exactly as before.
+        for status, count in self.counters.items():
+            out.setdefault(status, count)
         out.update(
-            queue_depth=depth,
-            shedding=self.admission.shedding,
-            draining=self._draining,
-            **{
+            {
                 "pool_" + key: value
                 for key, value in self.pool.stats().items()
-            },
+            }
         )
         return out
 
@@ -515,6 +543,11 @@ class ServingFrontend:
                         [request.features for request in ready]
                     )
                     span.set(generation=record.iteration_number)
+                    cascade_level = getattr(
+                        self.batcher, "last_cascade_level", None
+                    )
+                    if cascade_level is not None:
+                        span.set(cascade_level=cascade_level)
             except Exception as exc:
                 _LOG.exception("Serving batch failed.")
                 for request in ready:
@@ -535,6 +568,7 @@ class ServingFrontend:
                         status=STATUS_OK,
                         outputs=out,
                         generation=record.iteration_number,
+                        cascade_level=cascade_level,
                     )
                 )
 

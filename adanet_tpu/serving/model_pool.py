@@ -78,6 +78,12 @@ class PoolConfig:
     max_canary_failures: int = 0
     max_divergence: Optional[float] = None
     quarantine: bool = True
+    #: Follow the generation chain autonomously (`poll` discovers and
+    #: flips). The serving FLEET sets False: there the flip plane is
+    #: externally driven — `serving.fleet.FlipParticipant` stages and
+    #: `adopt()`s only fleet-committed generations, and an autonomous
+    #: local flip would break the all-or-none contract.
+    follow: bool = True
 
 
 @dataclasses.dataclass
@@ -88,6 +94,11 @@ class GenerationRecord:
     path: str
     program: Callable
     signature: Dict[str, Any]
+    #: The cheap-member cascade program and its calibration record
+    #: (`serving_signature.json`'s `cascade` block), when the
+    #: generation was published with one.
+    cascade_program: Optional[Callable] = None
+    cascade: Optional[Dict[str, Any]] = None
 
 
 def _default_loader(gen_dir: str) -> Tuple[Callable, Dict[str, Any]]:
@@ -98,6 +109,74 @@ def _default_loader(gen_dir: str) -> Tuple[Callable, Dict[str, Any]]:
     program = export_lib.load_serving_program(gen_dir)
     signature = export_lib.serving_signature(gen_dir)
     return program, signature
+
+
+class GateError(RuntimeError):
+    """A generation failed the verify/load/smoke gate."""
+
+
+def gate_generation(
+    path: str, loader: Optional[Callable] = None
+) -> GenerationRecord:
+    """Verify + load + smoke one published generation; returns the
+    servable record or raises `GateError`.
+
+    The stateless core of `ModelPool`'s flip gate, shared with the
+    fleet's flip participants (`serving/fleet/flip_coordinator.py`),
+    which stage generations OUTSIDE any pool and only `adopt()` them
+    after the fleet-wide commit. A generation with a cascade block in
+    its signature has the cascade program loaded and smoked too — a
+    corrupt cheap member must fail the gate exactly like a corrupt
+    full ensemble.
+    """
+    loader = loader or _default_loader
+    issues = integrity.verify_serving_generation(path)
+    if issues:
+        raise GateError("verification failed: %s" % issues)
+    with open(
+        os.path.join(path, integrity.GENERATION_MANIFEST)
+    ) as f:
+        t = int(json.load(f)["iteration_number"])
+    try:
+        faults.trip("serving.model_load")
+        program, signature = loader(path)
+    except Exception as exc:
+        raise GateError(
+            "load failed: %s: %s" % (type(exc).__name__, exc)
+        ) from exc
+    cascade_program = None
+    cascade = signature.get("cascade")
+    if cascade is not None:
+        try:
+            from adanet_tpu.core import export as export_lib
+
+            cascade_program = export_lib.load_serving_program(
+                path, filename=cascade.get("program")
+            )
+        except Exception as exc:
+            raise GateError(
+                "cascade load failed: %s: %s"
+                % (type(exc).__name__, exc)
+            ) from exc
+    record = GenerationRecord(
+        t, path, program, signature, cascade_program, cascade
+    )
+    try:
+        sample = _build_sample(signature.get("inputs", {}))
+        outputs = program(sample)
+        if not outputs_finite(outputs):
+            raise ValueError("non-finite outputs on the smoke sample")
+        if cascade_program is not None:
+            if not outputs_finite(cascade_program(sample)):
+                raise ValueError(
+                    "non-finite cascade outputs on the smoke sample"
+                )
+    except Exception as exc:
+        raise GateError(
+            "smoke execution failed: %s: %s"
+            % (type(exc).__name__, exc)
+        ) from exc
+    return record
 
 
 def _build_sample(tree, batch: int = 1):
@@ -241,6 +320,8 @@ class ModelPool:
         At most one flip is in flight: a staged canary must resolve
         before the next generation is considered.
         """
+        if not self.config.follow:
+            return False
         with self._lock:
             if self._canary is not None:
                 return False
@@ -297,30 +378,10 @@ class ModelPool:
                 "flip interrupted: %s: %s" % (type(exc).__name__, exc),
             )
             return
-        issues = integrity.verify_serving_generation(path)
-        if issues:
-            self._reject(t, path, "verification failed: %s" % issues)
-            return
         try:
-            faults.trip("serving.model_load")
-            program, signature = self._loader(path)
-        except Exception as exc:
-            self._reject(t, path, "load failed: %s: %s"
-                         % (type(exc).__name__, exc))
-            return
-        record = GenerationRecord(t, path, program, signature)
-        try:
-            sample = _build_sample(signature.get("inputs", {}))
-            outputs = program(sample)
-            if not outputs_finite(outputs):
-                raise ValueError("non-finite outputs on the smoke sample")
-        except Exception as exc:
-            self._reject(
-                t,
-                path,
-                "smoke execution failed: %s: %s"
-                % (type(exc).__name__, exc),
-            )
+            record = gate_generation(path, self._loader)
+        except GateError as exc:
+            self._reject(t, path, str(exc))
             return
         promoted = None
         with self._lock:
@@ -380,6 +441,23 @@ class ModelPool:
                 reject.path,
                 "canary failed (%d unhealthy batches)" % failures,
             )
+
+    # ------------------------------------------------------ externally gated
+
+    def adopt(self, record: GenerationRecord, how: str = "fleet") -> None:
+        """Installs an externally-gated generation as the incumbent.
+
+        The fleet flip path: `serving.fleet.FlipParticipant` runs the
+        verify/load/smoke gate (`gate_generation`) and the coordinated
+        canary itself, and only calls this after the fleet-wide
+        all-or-none commit. The swap is the same atomic reference flip
+        the autonomous path uses; a staged local canary (there should
+        be none in fleet mode) is discarded.
+        """
+        with self._lock:
+            self._attempted.add(self._identity(record.path))
+            self._promote_locked(record, how=how)
+        self._pin_store_closure(record)
 
     # ----------------------------------------------------- promote / reject
 

@@ -119,12 +119,21 @@ def publish_generation(
     predict_fn: Callable,
     sample_features: Any,
     store=None,
+    cascade=None,
 ) -> Optional[str]:
     """Exports and atomically publishes one serving generation.
 
     Returns the published directory, or None when this generation was
     already published (set-once: concurrent publishers and restarted
     searchers converge on one artifact).
+
+    With a `cascade` (`serving.fleet.cascade.CascadeSpec`), the cheap
+    member's program is exported alongside the full ensemble
+    (`cascade.stablehlo`) and calibrated on the spec's held-out stream
+    at publish time — temperature and confidence threshold land in the
+    serving signature's `cascade` block, inside the same digest-sealed
+    atomic publication, so a serving replica gets program + policy in
+    one verify-on-load unit.
 
     With an `ArtifactStore` attached, the generation is ALSO published
     as a ref closure (`serving/<dir-id>-gen<t>`): every artifact blob
@@ -151,6 +160,8 @@ def publish_generation(
         export_lib.export_serving_program(
             staging, predict_fn, sample_features
         )
+        if cascade is not None:
+            _export_cascade(staging, predict_fn, sample_features, cascade)
         write_generation_manifest(staging, iteration_number)
         try:
             os.replace(staging, final)
@@ -170,6 +181,59 @@ def publish_generation(
         "Published serving generation %d at %s", iteration_number, final
     )
     return final
+
+
+def _export_cascade(
+    staging: str, predict_fn: Callable, sample_features: Any, cascade
+) -> None:
+    """Exports + calibrates the cheap member inside the staging dir.
+
+    Runs BEFORE the manifest is written and the directory renamed, so
+    the cascade rides the same atomic, digest-sealed publication as
+    the full program. Calibration failures abort the whole publish
+    (the caller's staging cleanup) — a generation must never land with
+    a program but no threshold, or vice versa.
+    """
+    import numpy as np
+
+    import jax
+
+    from adanet_tpu.core import export as export_lib
+    from adanet_tpu.serving.fleet import cascade as cascade_lib
+
+    cheap_dir = tempfile.mkdtemp(prefix=".cascade-", dir=staging)
+    try:
+        export_lib.export_serving_program(
+            cheap_dir, cascade.predict_fn, sample_features
+        )
+        os.replace(
+            os.path.join(cheap_dir, export_lib.SERVING_FILE),
+            os.path.join(staging, export_lib.CASCADE_FILE),
+        )
+    finally:
+        shutil.rmtree(cheap_dir, ignore_errors=True)
+    features = cascade.calibration_features
+    cheap_out = jax.device_get(cascade.predict_fn(features))
+    full_out = jax.device_get(predict_fn(features))
+
+    def leaf(outputs):
+        if isinstance(outputs, dict):
+            return np.asarray(outputs[cascade.logits_key])
+        return np.asarray(outputs)
+
+    record = cascade_lib.calibrate(
+        leaf(cheap_out),
+        leaf(full_out),
+        labels=cascade.calibration_labels,
+        target_agreement=cascade.target_agreement,
+        logits_key=cascade.logits_key,
+    )
+    record["program"] = export_lib.CASCADE_FILE
+    signature_path = os.path.join(staging, export_lib.SIGNATURE_FILE)
+    with open(signature_path) as f:
+        signature = json.load(f)
+    signature[cascade_lib.SIGNATURE_KEY] = record
+    ckpt.write_json(staging, export_lib.SIGNATURE_FILE, signature)
 
 
 def serving_ref_name(model_dir: str, iteration_number: int) -> str:

@@ -528,6 +528,288 @@ def _serving_latency_section():
         }
 
 
+FLEET_SERVING_CLIENT_RAMP = tuple(
+    int(c)
+    for c in os.environ.get(
+        "ADANET_BENCH_FLEET_SERVING_RAMP", "2,4,8,16,32"
+    ).split(",")
+    if c
+)
+FLEET_SERVING_REQUESTS = int(
+    os.environ.get("ADANET_BENCH_FLEET_SERVING_REQUESTS", "20")
+)
+
+
+def _drive_fleet_clients(balancer, num_clients, requests_per_client):
+    """One closed-loop saturation step; returns the latency census."""
+    import collections
+    import threading
+
+    latencies = []
+    statuses = collections.Counter()
+    cascade_levels = collections.Counter()
+    lock = threading.Lock()
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(requests_per_client):
+            x = rng.randn(rng.randint(1, 5), 16).astype(np.float32)
+            start = time.monotonic()
+            result = balancer.submit({"x": x}, deadline_secs=60.0)
+            elapsed = time.monotonic() - start
+            with lock:
+                statuses[result.status] += 1
+                if result.ok:
+                    latencies.append(elapsed)
+                    if result.cascade_level is not None:
+                        cascade_levels[result.cascade_level] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(seed,))
+        for seed in range(num_clients)
+    ]
+    started = time.monotonic()
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=60.0 * requests_per_client)
+    elapsed = max(time.monotonic() - started, 1e-9)
+    lat_ms = np.asarray(sorted(1e3 * l for l in latencies))
+    answered = sum(cascade_levels.values())
+    return {
+        "clients": num_clients,
+        "qps": round(len(lat_ms) / elapsed, 1),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3)
+        if len(lat_ms)
+        else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3)
+        if len(lat_ms)
+        else None,
+        "statuses": dict(statuses),
+        "error": statuses.get("error", 0),
+        "fallthrough_rate": round(
+            cascade_levels.get(1, 0) / answered, 4
+        )
+        if answered
+        else None,
+    }
+
+
+def _measure_serving_fleet():
+    """Saturation curves for 1 vs 3 replicas plus cascade on/off (the
+    ISSUE 15 fleet gate's numbers).
+
+    Each arm publishes ONE real cascade-calibrated generation, launches
+    replica subprocesses through the same `tools/servectl.py` spawn
+    path operators use, and ramps closed-loop clients through the
+    `FleetBalancer` until the p99 knee (p99 above 3x the lightest
+    step's with no qps gain) or the ramp's end. `fleet_beats_single_qps`
+    is the headline verdict: the 3-replica fleet's peak throughput must
+    beat the single replica's. The cascade arms re-drive the 3-replica
+    fleet at a fixed mid-ramp load with the cascade disabled for the
+    latency/fallthrough delta.
+    """
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from adanet_tpu.distributed.scheduler import FileKV
+    from adanet_tpu.serving import publisher as publisher_lib
+    from adanet_tpu.serving.fleet import (
+        BalancerConfig,
+        CascadeSpec,
+        FleetBalancer,
+    )
+    from tools import servectl
+
+    root = tempfile.mkdtemp(prefix="adanet-bench-fleet-serving-")
+    rng = np.random.RandomState(0)
+    # The served "ensemble" mirrors AdaNet's additive structure: a
+    # small first member plus a HEAVY refinement member at reduced
+    # scale. The cascade's cheap tier is the first member alone —
+    # ~200x fewer FLOPs — and the full program is compute-bound enough
+    # (~30 MFLOP per 8-row batch) that the saturation curve measures
+    # the fleet, not python dispatch overhead.
+    m1_hidden = rng.randn(16, 64).astype(np.float32)
+    m1_head = rng.randn(64, 4).astype(np.float32)
+    m2_a = rng.randn(16, 1024).astype(np.float32) / 4
+    m2_b = rng.randn(1024, 2048).astype(np.float32) / 32
+    m2_c = rng.randn(2048, 4).astype(np.float32) / 8
+
+    def cheap_fn(features):
+        return {
+            "predictions": jnp.tanh(features["x"] @ m1_hidden) @ m1_head
+        }
+
+    def full_fn(features):
+        member1 = jnp.tanh(features["x"] @ m1_hidden) @ m1_head
+        member2 = (
+            jnp.tanh(jnp.tanh(features["x"] @ m2_a) @ m2_b) @ m2_c
+        )
+        return {"predictions": member1 + 0.5 * member2}
+
+    def run_fleet(tag, replicas, cascade, client_steps):
+        fleet_dir = os.path.join(root, tag)
+        model_dir = os.path.join(fleet_dir, "model")
+        os.makedirs(model_dir)
+        publisher_lib.publish_generation(
+            model_dir,
+            0,
+            full_fn,
+            {"x": np.zeros((4, 16), np.float32)},
+            cascade=CascadeSpec(
+                cheap_fn,
+                {"x": rng.randn(512, 16).astype(np.float32)},
+                target_agreement=0.97,
+            ),
+        )
+        ids = ["r%d" % i for i in range(replicas)]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # Fixed per-replica provisioning, the production fleet model:
+        # every replica (BOTH arms) runs single-threaded XLA. Without
+        # this, one replica's intra-op threads grab every host core —
+        # the single-server arm is then benching the whole machine and
+        # the comparison degenerates into scheduler-thrash roulette
+        # (observed: the same arms swung 130..600 qps run to run).
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_cpu_multi_thread_eigen=false"
+        ).strip()
+        ncpu = os.cpu_count() or 1
+        procs = [
+            servectl.spawn_replica(
+                fleet_dir,
+                model_dir,
+                rid,
+                env=env,
+                cascade=cascade,
+                heartbeat_interval=0.1,
+                # One core per replica (round-robin past the host's
+                # count): the fleet claim is "N replicas = N units of
+                # capacity", which only means something when a unit is
+                # a fixed slice of the machine.
+                taskset_cpu=i % ncpu,
+            )
+            for i, rid in enumerate(ids)
+        ]
+        balancer = None
+        try:
+            missing = servectl.wait_for_heartbeats(
+                fleet_dir, ids, timeout_secs=120.0
+            )
+            if missing:
+                raise RuntimeError(
+                    "replicas never heartbeat: %s" % missing
+                )
+            balancer = FleetBalancer(
+                FileKV(os.path.join(fleet_dir, "kv")),
+                config=BalancerConfig(refresh_interval_secs=0.05),
+            )
+            # One warmup pass compiles every replica's bucket shapes
+            # (cheap AND full program) outside the timed windows.
+            warm = _drive_fleet_clients(balancer, replicas * 2, 12)
+            if warm["error"]:
+                raise RuntimeError("warmup errors: %r" % warm)
+            steps = []
+            best_qps, first_p99 = 0.0, None
+            for clients in client_steps:
+                step = _drive_fleet_clients(
+                    balancer, clients, FLEET_SERVING_REQUESTS
+                )
+                steps.append(step)
+                if step["p99_ms"] is None:
+                    break
+                if first_p99 is None:
+                    first_p99 = step["p99_ms"]
+                knee = (
+                    step["p99_ms"] > 3.0 * first_p99
+                    and step["qps"] <= best_qps * 1.05
+                )
+                best_qps = max(best_qps, step["qps"])
+                if knee:
+                    break
+            return steps
+        finally:
+            if balancer is not None:
+                balancer.close()
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=30.0)
+                except Exception:
+                    proc.kill()
+            shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    try:
+        single = run_fleet(
+            "single", 1, True, FLEET_SERVING_CLIENT_RAMP
+        )
+        fleet = run_fleet("fleet3", 3, True, FLEET_SERVING_CLIENT_RAMP)
+        # Cascade delta at a fixed mid-ramp load on the 3-replica
+        # fleet: same model, cascade answered vs always-full.
+        mid = FLEET_SERVING_CLIENT_RAMP[
+            len(FLEET_SERVING_CLIENT_RAMP) // 2
+        ]
+        cascade_on = run_fleet("cascade-on", 3, True, (mid,))[-1]
+        cascade_off = run_fleet("cascade-off", 3, False, (mid,))[-1]
+        peak = lambda steps: max(
+            (s["qps"] for s in steps if s["qps"]), default=0.0
+        )
+        errors = sum(
+            s["error"]
+            for s in single + fleet + [cascade_on, cascade_off]
+        )
+        return {
+            "replicas_1": single,
+            "replicas_3": fleet,
+            "peak_qps_1": peak(single),
+            "peak_qps_3": peak(fleet),
+            # The ROADMAP item 2 verdict, machine-checkable.
+            "fleet_beats_single_qps": peak(fleet) > peak(single),
+            "cascade": {
+                "clients": mid,
+                "on": cascade_on,
+                "off": cascade_off,
+                "p50_delta_ms": (
+                    round(
+                        cascade_off["p50_ms"] - cascade_on["p50_ms"], 3
+                    )
+                    if cascade_on["p50_ms"] is not None
+                    and cascade_off["p50_ms"] is not None
+                    else None
+                ),
+                "fallthrough_rate": cascade_on["fallthrough_rate"],
+            },
+            "error": errors,
+            "requests_per_client": FLEET_SERVING_REQUESTS,
+            "backend": jax.default_backend(),
+            "program": "core/export.py StableHLO 2-member additive "
+            "ensemble (16->64->4 member + 0.5x 16->1024->2048->4 "
+            "refinement); cascade tier = first member alone",
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _serving_fleet_section():
+    """`serving_fleet` with the structured-skip contract of every
+    section; `ADANET_BENCH_FLEET_SERVING=0` opts out (tier-1's
+    bench-contract test — the fleet path is already chaos-gated
+    in-process in tests/test_serving_fleet.py)."""
+    if os.environ.get("ADANET_BENCH_FLEET_SERVING") == "0":
+        return {"skipped": "fleet_serving_bench_disabled_by_env"}
+    try:
+        return _measure_serving_fleet()
+    except Exception as exc:
+        return {
+            "skipped": "fleet_serving_bench_failed",
+            "error": "%s: %s" % (type(exc).__name__, exc),
+        }
+
+
 def _measure_roofline(builders, batch_size, steps=None, model_name=None):
     """Per-component roofline of one candidate training step (ROADMAP
     item 1: "report a per-component roofline breakdown in bench.py so
@@ -1104,6 +1386,9 @@ def _emit_unavailable_record():
         # a TPU outage doesn't blank it: real numbers certify the plane
         # the same way cpu_contract_ok certifies the training machinery.
         "serving_latency": _serving_latency_section(),
+        # The replicated fleet saturates on CPU subprocess replicas —
+        # real qps/p99 curves regardless of TPU health.
+        "serving_fleet": _serving_fleet_section(),
         # Warm starts are host+store machinery; the accounting is real
         # on CPU (first numbers: BENCH_warmstart_r01.json).
         "warm_start": _warm_start_section(),
@@ -1246,6 +1531,9 @@ def main():
         # synthetic clients) through ModelPool -> Batcher -> Frontend on
         # the exported StableHLO program.
         "serving_latency": _serving_latency_section(),
+        # Replicated-fleet saturation: 1 vs 3 replicas to the p99 knee
+        # plus the cascade on/off latency delta (ROADMAP item 2).
+        "serving_fleet": _serving_fleet_section(),
         # Compile-cache hit/miss accounting across two separate search
         # runs sharing one content-addressed artifact store.
         "warm_start": _warm_start_section(),

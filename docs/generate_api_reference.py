@@ -40,6 +40,7 @@ API_MODULES = [
     "adanet_tpu.replay",
     "adanet_tpu.robustness",
     "adanet_tpu.serving",
+    "adanet_tpu.serving.fleet",
     "adanet_tpu.store",
     "adanet_tpu.experimental",
     "adanet_tpu.models",

@@ -74,6 +74,11 @@ def test_bench_prints_one_json_line():
     env["ADANET_BENCH_MEASURE_STEPS"] = "2"
     env["ADANET_BENCH_NASNET_CELLS"] = "3"
     env["ADANET_BENCH_NASNET_FILTERS"] = "8"
+    # The replicated-fleet saturation ramp spawns replica subprocesses
+    # and runs for minutes; tier-1 asserts its structured opt-out here
+    # (the machinery is chaos-gated in tests/test_serving_fleet.py and
+    # recorded in BENCH_serving_r02.json).
+    env["ADANET_BENCH_FLEET_SERVING"] = "0"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py")],
         cwd=repo,
@@ -112,6 +117,10 @@ def test_bench_prints_one_json_line():
     # line (ISSUE 7): honest percentiles, zero 5xx-equivalents.
     assert result["serving_latency"]["p99_ms"] > 0
     assert result["serving_latency"]["error"] == 0
+    # The fleet saturation section honored its structured opt-out.
+    assert result["serving_fleet"] == {
+        "skipped": "fleet_serving_bench_disabled_by_env"
+    }
     # Warm-start accounting across runs sharing one artifact store
     # (ISSUE 10): the replayed run compiles and trains nothing.
     warm = result["warm_start"]
@@ -190,6 +199,10 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     # RUN_SLOW (full); the contract check only asserts the section's
     # structured opt-out so tier-1 doesn't pay for a third fleet run.
     env["ADANET_BENCH_FLEET"] = "0"
+    # Same contract for the serving-fleet saturation section: its real
+    # machinery is chaos-gated in tests/test_serving_fleet.py, and the
+    # recorded curves live in BENCH_serving_r02.json.
+    env["ADANET_BENCH_FLEET_SERVING"] = "0"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py")],
         cwd=repo,
@@ -220,6 +233,9 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     # the recorded numbers).
     assert result["fleet_search"] == {
         "skipped": "fleet_bench_disabled_by_env"
+    }
+    assert result["serving_fleet"] == {
+        "skipped": "fleet_serving_bench_disabled_by_env"
     }
     # The warm-start section is host+store machinery: real numbers on
     # the outage path too.

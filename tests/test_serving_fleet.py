@@ -1,0 +1,1354 @@
+"""Serving-fleet suite (ISSUE 15 tentpole): FileKV set-once semantics,
+the transport codec, the typed watermark snapshot, balancer hysteresis
+and deadline-aware retry against a mocked clock (no sleeps, no jax
+programs), the flip coordinator's claim/commit/rollback state machine,
+cascade calibration + serve-time bit-identity, and the chaos gate — a
+3-replica subprocess fleet under closed-loop traffic surviving SIGKILL
+of one replica mid-fleet-flip with zero dropped requests, converging
+to one generation, shared store fsck-clean.
+"""
+
+import collections
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from adanet_tpu.distributed.scheduler import FileKV, InMemoryKV
+from adanet_tpu.robustness import faults
+from adanet_tpu.serving import (
+    Batcher,
+    BatcherConfig,
+    FrontendConfig,
+    ModelPool,
+    PoolConfig,
+    ServingFrontend,
+    publisher,
+)
+from adanet_tpu.serving.fleet import (
+    BalancerConfig,
+    CascadeSpec,
+    FleetBalancer,
+    FlipConfig,
+    FlipParticipant,
+    NAMESPACE,
+    bootstrap_generation,
+    cascade as cascade_lib,
+    publish_heartbeat,
+    read_heartbeats,
+    transport,
+)
+from adanet_tpu.serving.fleet import flip_coordinator as flip_lib
+from adanet_tpu.serving.model_pool import GateError, GenerationRecord
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(TESTS_DIR)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, secs: float) -> None:
+        self.now += secs
+
+
+# ----------------------------------------------------------------- FileKV
+
+
+def test_filekv_set_once_and_scan(tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    assert kv.set("fleet/hb/r0", b"a", overwrite=False)
+    assert not kv.set("fleet/hb/r0", b"b", overwrite=False)
+    assert kv.try_get("fleet/hb/r0") == b"a"
+    # Overwrite mode is last-writer-wins (heartbeats).
+    assert kv.set("fleet/hb/r0", b"c")
+    assert kv.try_get("fleet/hb/r0") == b"c"
+    kv.set("fleet/flip/gen-1/outcome", b"{}", overwrite=False)
+    assert set(kv.scan("fleet/hb/")) == {"fleet/hb/r0"}
+    assert set(kv.scan("fleet/")) == {
+        "fleet/hb/r0",
+        "fleet/flip/gen-1/outcome",
+    }
+    kv.delete("fleet/hb/r0")
+    assert kv.try_get("fleet/hb/r0") is None
+
+
+def test_filekv_set_once_across_processes(tmp_path):
+    """The claim primitive must hold across PROCESSES: N concurrent
+    writers, exactly one winner."""
+    root = str(tmp_path / "kv")
+    FileKV(root)
+    script = (
+        "import sys\n"
+        "from adanet_tpu.distributed.scheduler import FileKV\n"
+        "kv = FileKV(sys.argv[1])\n"
+        "print(int(kv.set('claim', sys.argv[2].encode(), overwrite=False)))\n"
+    )
+    procs = [
+        subprocess.run(
+            [sys.executable, "-c", script, root, "w%d" % i],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=REPO_DIR,
+        )
+        for i in range(3)
+    ]
+    wins = [int(p.stdout.strip()) for p in procs]
+    assert sum(wins) == 1, wins
+    assert FileKV(root).try_get("claim") is not None
+
+
+def test_filekv_get_is_bounded(tmp_path):
+    kv = FileKV(str(tmp_path / "kv"))
+    start = time.monotonic()
+    with pytest.raises(TimeoutError):
+        kv.get("never", timeout_secs=0.2)
+    assert time.monotonic() - start < 5.0
+
+
+# -------------------------------------------------------------- transport
+
+
+def test_transport_codec_round_trip_bit_exact():
+    tree = {
+        "features": {
+            "x": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "mask": np.array([True, False, True]),
+        },
+        "nested": [1, "two", None, {"deep": np.float64(2.5)}],
+        "pair": (np.int32(7), 8),
+    }
+    out = transport.decode_message(transport.encode_message(tree))
+    np.testing.assert_array_equal(
+        out["features"]["x"], tree["features"]["x"]
+    )
+    assert out["features"]["x"].dtype == np.float32
+    np.testing.assert_array_equal(
+        out["features"]["mask"], tree["features"]["mask"]
+    )
+    assert out["nested"][:3] == [1, "two", None]
+    assert out["nested"][3]["deep"] == 2.5
+    assert isinstance(out["pair"], tuple) and out["pair"][1] == 8
+    # Scalar leaves keep their 0-d SHAPE: a scalar arriving as (1,)
+    # is a different pytree structure and would fail the replica's
+    # exported-signature check.
+    scalar = transport.decode_message(
+        transport.encode_message({"scale": np.float32(0.5)})
+    )["scale"]
+    assert scalar.shape == () and scalar == np.float32(0.5)
+    assert np.asarray(out["nested"][3]["deep"]).shape == ()
+
+
+def test_transport_rejects_bad_messages_in_taxonomy():
+    """Unencodable input fails the SENDER with TypeError; a torn frame
+    decodes to TransportError (never a bare struct.error escaping the
+    balancer's retry contract)."""
+    with pytest.raises(TypeError, match="dtype"):
+        transport.encode_message(
+            {"bad": np.array([object()], dtype=object)}
+        )
+    with pytest.raises(TypeError, match="non-string"):
+        transport.encode_message({0: np.zeros(2)})
+    with pytest.raises(transport.TransportError, match="truncated"):
+        transport.decode_message(b"\x00")
+
+
+# -------------------------------------------- watermark snapshot (satellite)
+
+
+def _write_fake_generation(model_dir, t):
+    gen = publisher.generation_dir(model_dir, t)
+    os.makedirs(gen)
+    with open(os.path.join(gen, "serving.stablehlo"), "wb") as f:
+        f.write(b"program-%d" % t)
+    with open(os.path.join(gen, "serving_signature.json"), "w") as f:
+        json.dump(
+            {"inputs": {"x": {"shape": ["batch", "3"], "dtype": "float32"}}},
+            f,
+        )
+    publisher.write_generation_manifest(gen, t)
+    return gen
+
+
+def _stub_loader(gen_dir):
+    from adanet_tpu.robustness import integrity
+
+    with open(
+        os.path.join(gen_dir, integrity.GENERATION_MANIFEST)
+    ) as f:
+        t = int(json.load(f)["iteration_number"])
+
+    def program(features):
+        return {"y": np.asarray(features["x"], np.float32) * (t + 1)}
+
+    with open(os.path.join(gen_dir, "serving_signature.json")) as f:
+        return program, json.load(f)
+
+
+def test_frontend_stats_typed_snapshot_with_aliases(tmp_path):
+    """Satellite: stats() is a machine-readable watermark snapshot —
+    monotonic timestamp + generation id + typed watermarks — with the
+    old mixed debug keys kept as aliases for one release."""
+    _write_fake_generation(str(tmp_path), 0)
+    pool = ModelPool(str(tmp_path), PoolConfig(), loader=_stub_loader)
+    pool.poll()
+    clock = FakeClock(500.0)
+    frontend = ServingFrontend(
+        Batcher(pool, BatcherConfig(bucket_sizes=(4,), jit=False)),
+        FrontendConfig(),
+        clock=clock,
+    )
+    snap = frontend.stats()
+    assert snap["ts_monotonic"] == 500.0
+    assert snap["generation"] == 0
+    assert snap["queue_depth"] == 0
+    assert snap["wait_ewma_secs"] == 0.0
+    assert snap["exec_ewma_secs"] == 0.0
+    assert snap["shedding"] is False and snap["draining"] is False
+    assert snap["statuses"] == {}
+    # Aliases: pool_* keys and bare status counts survive one release.
+    assert snap["pool_active_generation"] == 0
+    frontend._count("shed")
+    snap = frontend.stats()
+    assert snap["statuses"] == {"shed": 1}
+    assert snap["shed"] == 1  # deprecated top-level alias
+
+
+# --------------------------------------------------- balancer (mocked clock)
+
+
+def _beat(kv, replica_id, seq, ts, **overrides):
+    payload = {
+        "replica_id": replica_id,
+        "seq": seq,
+        "ts": ts,
+        "address": "/tmp/%s.sock" % replica_id,
+        "generation": 0,
+        "queue_depth": 0,
+        "wait_ewma_secs": 0.0,
+        "exec_ewma_secs": 0.01,
+        "shedding": False,
+        "draining": False,
+    }
+    payload.update(overrides)
+    publish_heartbeat(kv, NAMESPACE, replica_id, payload)
+
+
+def _admitted_ids(balancer):
+    return {t.replica_id for t in balancer.admitted()}
+
+
+def test_balancer_stale_exclusion_and_readmission_boundaries():
+    """Hysteresis: exclusion is immediate at staleness; re-admission
+    requires EXACTLY readmit_beats consecutive fresh healthy beats."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(
+            stale_after_secs=1.0,
+            readmit_beats=2,
+            refresh_interval_secs=0,
+        ),
+        clock=clock,
+    )
+    for seq in (1, 2):
+        _beat(kv, "r0", seq, clock.now)
+        balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+    # No new beat for just under the stale window: still admitted.
+    clock.advance(0.99)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+    # Crossing the boundary excludes immediately.
+    clock.advance(0.02)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()
+    # One fresh beat is NOT enough to re-admit (hysteresis)...
+    _beat(kv, "r0", 3, clock.now)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()
+    # ...a refresh without a NEW beat does not count toward the streak...
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()
+    # ...the second consecutive fresh beat crosses the boundary.
+    _beat(kv, "r0", 4, clock.now)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+
+
+def test_balancer_shedding_exclusion_resets_streak():
+    kv = InMemoryKV()
+    clock = FakeClock()
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(
+            stale_after_secs=10.0,
+            readmit_beats=2,
+            refresh_interval_secs=0,
+        ),
+        clock=clock,
+    )
+    for seq in (1, 2):
+        _beat(kv, "r0", seq, clock.now)
+        balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+    _beat(kv, "r0", 3, clock.now, shedding=True)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()
+    # A healthy beat, then another shedding one: the streak resets.
+    _beat(kv, "r0", 4, clock.now)
+    balancer.refresh()
+    _beat(kv, "r0", 5, clock.now, shedding=True)
+    balancer.refresh()
+    _beat(kv, "r0", 6, clock.now)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()
+    _beat(kv, "r0", 7, clock.now)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+
+
+def test_balancer_respawned_replica_readmits_despite_seq_reset():
+    """A respawned replica restarts its heartbeat counter at 1; the
+    balancer must read the RESET as a fresh incarnation, not as a beat
+    older than the pre-crash seq (which would exclude the replica for
+    roughly its previous uptime)."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(
+            stale_after_secs=1.0,
+            readmit_beats=2,
+            refresh_interval_secs=0,
+        ),
+        clock=clock,
+    )
+    for seq in (100000, 100001):
+        _beat(kv, "r0", seq, clock.now)
+        balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+    # SIGKILL: no beats past the stale window -> excluded.
+    clock.advance(2.0)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()
+    # Respawn: the counter restarts far below the old seq.
+    _beat(kv, "r0", 1, clock.now, pid=999)
+    balancer.refresh()
+    _beat(kv, "r0", 2, clock.now, pid=999)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+
+
+def test_balancer_forgets_replicas_whose_heartbeat_key_vanished():
+    """A drained replica DELETES its heartbeat key; the balancer must
+    re-evaluate absent keys (stale -> excluded) and eventually forget
+    them, rather than keeping the last verdict forever."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(
+            stale_after_secs=1.0,
+            readmit_beats=1,
+            forget_after_secs=5.0,
+            refresh_interval_secs=0,
+        ),
+        clock=clock,
+    )
+    _beat(kv, "r0", 1, clock.now)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == {"r0"}
+    # The replica drains and deletes its key while still admitted.
+    kv.delete("%s/hb/r0" % NAMESPACE)
+    clock.advance(1.5)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == set()  # stale, not still-admitted
+    clock.advance(5.0)
+    balancer.refresh()
+    assert "r0" not in balancer._tracked  # forgotten entirely
+    assert balancer.choose() is None  # gone from the brownout fallback
+
+
+def test_balancer_power_of_two_prefers_lower_score():
+    kv = InMemoryKV()
+    clock = FakeClock()
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(
+            readmit_beats=1,
+            latency_weight=100.0,
+            refresh_interval_secs=0,
+        ),
+        clock=clock,
+    )
+    for seq in (1,):
+        _beat(kv, "deep", seq, clock.now, queue_depth=50)
+        _beat(kv, "slow", seq, clock.now, wait_ewma_secs=1.0)
+        _beat(kv, "good", seq, clock.now)
+    balancer.refresh()
+    assert _admitted_ids(balancer) == {"deep", "slow", "good"}
+    # With two candidates sampled per pick, 'good' (score ~1) must win
+    # every pairing it appears in; 'deep' (50) beats 'slow' (100).
+    import random
+
+    wins = collections.Counter(
+        balancer.choose().replica_id
+        for _ in range(40)
+    )
+    assert wins["slow"] == 0
+    assert wins["good"] > 0
+
+
+class _ScriptedTransport:
+    """address -> list of scripted replies / exceptions."""
+
+    def __init__(self, scripts, log):
+        self._scripts = scripts
+        self._log = log
+
+    def __call__(self, address):
+        outer = self
+
+        class _Client:
+            def send(self, message, timeout_secs=None):
+                outer._log.append(address)
+                action = outer._scripts[address].pop(0)
+                if isinstance(action, Exception):
+                    raise action
+                return action
+
+            def close(self):
+                pass
+
+        return _Client()
+
+
+def test_balancer_deadline_aware_retry_on_shed():
+    """A shed answer retries on a DIFFERENT replica while the deadline
+    budget covers another execution; the result is the retry's."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    log = []
+    scripts = {
+        "/tmp/r0.sock": [{"status": "shed", "retry_after": 0.05}],
+        "/tmp/r1.sock": [{"status": "ok", "generation": 0, "outputs": 1}],
+    }
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(readmit_beats=1, refresh_interval_secs=0),
+        transport_factory=_ScriptedTransport(scripts, log),
+        clock=clock,
+    )
+    # r0 scores better, so the first pick is deterministic.
+    _beat(kv, "r0", 1, clock.now, queue_depth=0)
+    _beat(kv, "r1", 1, clock.now, queue_depth=10)
+    result = balancer.submit({"x": 1}, deadline_secs=5.0)
+    assert result.ok and result.outputs == 1
+    assert log == ["/tmp/r0.sock", "/tmp/r1.sock"]
+    assert balancer._m_retries.value == 1
+
+
+def test_balancer_exhausted_budget_returns_shed_without_retry():
+    kv = InMemoryKV()
+    clock = FakeClock()
+    log = []
+
+    class _SlowShed(Exception):
+        pass
+
+    def shed_and_burn():
+        clock.advance(10.0)  # the attempt consumed the whole budget
+        return {"status": "shed", "retry_after": 0.05}
+
+    class _Factory:
+        def __call__(self, address):
+            class _Client:
+                def send(self, message, timeout_secs=None):
+                    log.append(address)
+                    return shed_and_burn()
+
+                def close(self):
+                    pass
+
+            return _Client()
+
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(readmit_beats=1, refresh_interval_secs=0),
+        transport_factory=_Factory(),
+        clock=clock,
+    )
+    _beat(kv, "r0", 1, clock.now)
+    _beat(kv, "r1", 1, clock.now)
+    result = balancer.submit({"x": 1}, deadline_secs=5.0)
+    assert result.status == "shed"
+    assert len(log) == 1  # no budget left: no second attempt
+
+
+def test_balancer_transport_error_excludes_and_retries():
+    kv = InMemoryKV()
+    clock = FakeClock()
+    log = []
+    scripts = {
+        "/tmp/r0.sock": [transport.TransportError("connection refused")],
+        "/tmp/r1.sock": [{"status": "ok", "generation": 1, "outputs": 2}],
+    }
+    balancer = FleetBalancer(
+        kv,
+        config=BalancerConfig(readmit_beats=1, refresh_interval_secs=0),
+        transport_factory=_ScriptedTransport(scripts, log),
+        clock=clock,
+    )
+    _beat(kv, "r0", 1, clock.now, queue_depth=0)
+    _beat(kv, "r1", 1, clock.now, queue_depth=10)
+    result = balancer.submit({"x": 1}, deadline_secs=5.0)
+    assert result.ok and result.generation == 1
+    assert log == ["/tmp/r0.sock", "/tmp/r1.sock"]
+    # Connection-level evidence excluded r0 immediately.
+    assert _admitted_ids(balancer) == {"r1"}
+    assert balancer._m_transport_errors.value == 1
+
+
+# ---------------------------------------- flip coordinator (mocked clock)
+
+
+class FakePool:
+    def __init__(self, active=None):
+        self._active = active
+        self.adopted = []
+        self._loader = None
+
+    @property
+    def active(self):
+        return self._active
+
+    def adopt(self, record, how="fleet"):
+        self._active = record
+        self.adopted.append((record.iteration_number, how))
+
+
+def _gen_dir(tmp_path, t):
+    path = publisher.generation_dir(str(tmp_path), t)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _record(t, path):
+    return GenerationRecord(
+        t, path, lambda features: {"y": np.ones(2)}, {}
+    )
+
+
+def _participant(
+    kv,
+    replica_id,
+    pool,
+    model_dir,
+    fresh,
+    clock,
+    stage_fn=None,
+    canary_fn=None,
+    config=None,
+):
+    return FlipParticipant(
+        kv,
+        NAMESPACE,
+        replica_id,
+        pool,
+        model_dir,
+        fresh_replicas=lambda: set(fresh),
+        stage_fn=stage_fn
+        or (lambda path: _record(flip_target_iter(path), path)),
+        canary_fn=canary_fn,
+        config=config or FlipConfig(lead_ttl_secs=5.0),
+        clock=clock,
+    )
+
+
+def flip_target_iter(path):
+    return int(os.path.basename(path).split("-")[1])
+
+
+def test_flip_commit_happy_path(tmp_path):
+    """Leader canaries, followers stage+ready, one set-once commit,
+    everyone adopts — all-or-none, no sleeps."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pools = {r: FakePool(_record(0, gen0)) for r in ("r0", "r1")}
+    fresh = {"r0", "r1"}
+    parts = {
+        r: _participant(kv, r, pools[r], str(tmp_path), fresh, clock)
+        for r in ("r0", "r1")
+    }
+    _gen_dir(tmp_path, 1)
+    # r0 steps first: wins the lead claim, canaries, writes ready, but
+    # cannot commit yet (r1 not ready).
+    assert parts["r0"].step() is None
+    assert parts["r1"].step() == "ready"
+    assert parts["r0"].step() == "committed"
+    assert parts["r1"].step() == "committed"
+    assert pools["r0"].adopted == [(1, "fleet")]
+    assert pools["r1"].adopted == [(1, "fleet")]
+    outcome = json.loads(
+        kv.try_get("%s/flip/%s/outcome" % (NAMESPACE, _target(tmp_path, 1)))
+    )
+    assert outcome["decision"] == "commit"
+    assert sorted(outcome["participants"]) == ["r0", "r1"]
+
+
+def _target(tmp_path, t):
+    return flip_lib.target_id(
+        t, publisher.generation_dir(str(tmp_path), t)
+    )
+
+
+def test_flip_canary_failure_aborts_fleet_wide(tmp_path):
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pools = {r: FakePool(_record(0, gen0)) for r in ("r0", "r1")}
+    fresh = {"r0", "r1"}
+    parts = {
+        r: _participant(
+            kv,
+            r,
+            pools[r],
+            str(tmp_path),
+            fresh,
+            clock,
+            canary_fn=lambda record: (False, "diverged"),
+        )
+        for r in ("r0", "r1")
+    }
+    _gen_dir(tmp_path, 1)
+    assert parts["r0"].step() == "aborted"
+    # r1 never engaged (the abort pre-dated its first step): it
+    # resolves the target silently, without ever staging.
+    assert parts["r1"].step() is None
+    # All-or-none: NOBODY flipped; the incumbent keeps serving.
+    assert pools["r0"].adopted == [] and pools["r1"].adopted == []
+    # The aborted target is terminal: no replica retries it.
+    assert parts["r0"].step() is None and parts["r1"].step() is None
+
+
+def test_flip_follower_stage_failure_aborts(tmp_path):
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pools = {r: FakePool(_record(0, gen0)) for r in ("r0", "r1")}
+    fresh = {"r0", "r1"}
+
+    def bad_stage(path):
+        raise GateError("verification failed: rot")
+
+    leader = _participant(
+        kv, "r0", pools["r0"], str(tmp_path), fresh, clock
+    )
+    follower = _participant(
+        kv,
+        "r1",
+        pools["r1"],
+        str(tmp_path),
+        fresh,
+        clock,
+        stage_fn=bad_stage,
+    )
+    _gen_dir(tmp_path, 1)
+    assert leader.step() is None  # leads, canaries, waits for r1
+    assert follower.step() == "stage_failed"
+    assert leader.step() == "aborted"
+    assert follower.step() == "aborted"
+    assert pools["r0"].adopted == [] and pools["r1"].adopted == []
+
+
+def test_flip_leader_death_successor_takes_over(tmp_path):
+    """The lead token carries its own deadline: a canary SIGKILLed
+    mid-flip costs one TTL, then a survivor claims the next attempt
+    and completes the flip."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pools = {r: FakePool(_record(0, gen0)) for r in ("r0", "r1")}
+    fresh = {"r0", "r1"}
+    dead_leader = _participant(
+        kv,
+        "r0",
+        pools["r0"],
+        str(tmp_path),
+        fresh,
+        clock,
+        config=FlipConfig(lead_ttl_secs=5.0),
+        # The leader stages + canaries, writes ready... and "dies"
+        # (we simply stop stepping it).
+    )
+    survivor = _participant(
+        kv,
+        "r1",
+        pools["r1"],
+        str(tmp_path),
+        {"r1"},  # r0's heartbeat went stale with it
+        clock,
+        config=FlipConfig(lead_ttl_secs=5.0),
+    )
+    _gen_dir(tmp_path, 1)
+    assert dead_leader.step() is None  # r0 holds lead-0, waits for r1
+    # r1 is a follower while the token is live.
+    assert survivor.step() == "ready"
+    assert survivor.step() is None
+    assert pools["r1"].adopted == []
+    # The token expires; r1 claims lead-1, canaries, and commits with
+    # the fresh set (itself — r0 is stale).
+    clock.advance(6.0)
+    assert survivor.step() == "committed"
+    assert pools["r1"].adopted == [(1, "fleet")]
+    # The dead leader respawning late observes the commit and adopts.
+    assert dead_leader.step() == "committed"
+    assert pools["r0"].adopted == [(1, "fleet")]
+
+
+def test_flip_live_leader_renews_token_past_half_ttl(tmp_path):
+    """An alive leader stuck waiting for slow followers must renew its
+    lead token — otherwise every prepare phase longer than the TTL
+    spawns a redundant successor canary."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pool = FakePool(_record(0, gen0))
+    leader = _participant(
+        kv,
+        "r0",
+        pool,
+        str(tmp_path),
+        {"r0", "r1"},  # r1 stays fresh but slow to stage
+        clock,
+        config=FlipConfig(lead_ttl_secs=10.0, ready_timeout_secs=500.0),
+    )
+    _gen_dir(tmp_path, 1)
+    assert leader.step() is None
+    target = _target(tmp_path, 1)
+    token_key = "%s/flip/%s/lead-0" % (NAMESPACE, target)
+    first_deadline = json.loads(kv.try_get(token_key))["deadline"]
+    # Past half the TTL, a step renews the deadline in place.
+    clock.advance(6.0)
+    assert leader.step() is None
+    renewed = json.loads(kv.try_get(token_key))
+    assert renewed["replica"] == "r0"
+    assert renewed["deadline"] > first_deadline
+    # A peer stepping now still sees a LIVE leader, not an expired one.
+    follower = _participant(
+        kv, "r1", FakePool(_record(0, gen0)), str(tmp_path),
+        {"r0", "r1"}, clock,
+        config=FlipConfig(lead_ttl_secs=10.0),
+    )
+    assert follower.step() == "ready"
+    assert leader.step() == "committed"
+
+
+def test_flip_dead_follower_drops_from_required_set(tmp_path):
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pools = {r: FakePool(_record(0, gen0)) for r in ("r0", "r1", "r2")}
+    fresh = {"r0", "r1", "r2"}
+    parts = {
+        r: _participant(kv, r, pools[r], str(tmp_path), fresh, clock)
+        for r in ("r0", "r1", "r2")
+    }
+    _gen_dir(tmp_path, 1)
+    assert parts["r0"].step() is None
+    assert parts["r1"].step() == "ready"
+    # r2 dies before staging; its heartbeat goes stale.
+    fresh.discard("r2")
+    assert parts["r0"].step() == "committed"
+    assert parts["r1"].step() == "committed"
+    assert pools["r2"].adopted == []
+    # r2 respawns: bootstrap resolves the committed generation.
+    entry = bootstrap_generation(kv, NAMESPACE, str(tmp_path))
+    assert entry is not None and entry[0] == 1
+
+
+def test_flip_ready_timeout_aborts(tmp_path):
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pool = FakePool(_record(0, gen0))
+    # r1 stays FRESH (heartbeating) but never writes ready — a wedged
+    # replica, not a dead one: the leader must abort, not wait forever.
+    leader = _participant(
+        kv,
+        "r0",
+        pool,
+        str(tmp_path),
+        {"r0", "r1"},
+        clock,
+        config=FlipConfig(lead_ttl_secs=500.0, ready_timeout_secs=60.0),
+    )
+    _gen_dir(tmp_path, 1)
+    assert leader.step() is None
+    clock.advance(61.0)
+    assert leader.step() == "aborted"
+    assert pool.adopted == []
+
+
+def test_bootstrap_generation_resolution(tmp_path):
+    kv = InMemoryKV()
+    _gen_dir(tmp_path, 0)
+    _gen_dir(tmp_path, 1)
+    # No flip records: newest publication.
+    assert bootstrap_generation(kv, NAMESPACE, str(tmp_path))[0] == 1
+    # A pending (undecided) flip of gen 1: join at the incumbent below.
+    target = _target(tmp_path, 1)
+    kv.set(
+        "%s/flip/%s/lead-0" % (NAMESPACE, target),
+        json.dumps({"replica": "r9", "deadline": 1e18}),
+        overwrite=False,
+    )
+    assert bootstrap_generation(kv, NAMESPACE, str(tmp_path))[0] == 0
+    # Once committed, the committed generation wins.
+    kv.set(
+        "%s/flip/%s/outcome" % (NAMESPACE, target),
+        json.dumps({"decision": "commit"}),
+        overwrite=False,
+    )
+    assert bootstrap_generation(kv, NAMESPACE, str(tmp_path))[0] == 1
+
+
+def test_flip_mid_flight_publication_supersedes_and_converges(tmp_path):
+    """A generation published while a flip is in flight must not split
+    the fleet across two targets that starve each other: participants
+    abandon the older target (set-once `superseded` abort) and the
+    fleet converges on the newest publication."""
+    kv = InMemoryKV()
+    clock = FakeClock()
+    gen0 = _gen_dir(tmp_path, 0)
+    pools = {r: FakePool(_record(0, gen0)) for r in ("r0", "r1")}
+    fresh = {"r0", "r1"}
+    parts = {
+        r: _participant(kv, r, pools[r], str(tmp_path), fresh, clock)
+        for r in ("r0", "r1")
+    }
+    _gen_dir(tmp_path, 1)
+    assert parts["r0"].step() is None  # r0 leads gen-1, waits for r1
+    # gen-2 lands before r1 ever saw gen-1.
+    _gen_dir(tmp_path, 2)
+    assert parts["r1"].step() is None  # r1 leads gen-2, waits for r0
+    # r0's next step abandons gen-1 (superseded abort) and joins gen-2.
+    assert parts["r0"].step() == "ready"
+    gen1_outcome = json.loads(
+        kv.try_get(
+            "%s/flip/%s/outcome" % (NAMESPACE, _target(tmp_path, 1))
+        )
+    )
+    assert gen1_outcome["decision"] == "abort"
+    assert "superseded" in gen1_outcome["reason"]
+    assert parts["r1"].step() == "committed"
+    assert parts["r0"].step() == "committed"
+    assert pools["r0"].adopted == [(2, "fleet")]
+    assert pools["r1"].adopted == [(2, "fleet")]
+    # The commit GC'd the superseded target's records — flip history
+    # must not grow the KV (and the scans riding it) without bound.
+    gen1_keys = [
+        key
+        for key in kv.scan("%s/flip/" % NAMESPACE)
+        if "/%s/" % _target(tmp_path, 1) in key
+    ]
+    assert gen1_keys == []
+
+
+def test_bootstrap_skips_aborted_generation(tmp_path):
+    """A respawning replica must never adopt a generation the fleet
+    ABORTED (it would diverge from the incumbent-serving fleet) — but
+    a republished dir for the same iteration is a fresh target and
+    becomes eligible again."""
+    kv = InMemoryKV()
+    _gen_dir(tmp_path, 0)
+    gen1 = _gen_dir(tmp_path, 1)
+    target = _target(tmp_path, 1)
+    kv.set(
+        "%s/flip/%s/outcome" % (NAMESPACE, target),
+        json.dumps({"decision": "abort", "reason": "canary failed"}),
+        overwrite=False,
+    )
+    assert bootstrap_generation(kv, NAMESPACE, str(tmp_path))[0] == 0
+    # Republish after quarantine: the RENAMED dir keeps the aborted
+    # inode alive, so the fresh publication is a new identity and
+    # becomes eligible again.
+    os.replace(gen1, gen1 + ".corrupt")
+    _gen_dir(tmp_path, 1)
+    assert bootstrap_generation(kv, NAMESPACE, str(tmp_path))[0] == 1
+
+
+def test_replica_heartbeat_fault_site_armed():
+    """Chaos coverage for `serving.replica_heartbeat` (jaxlint JL015):
+    an injected failure surfaces from the publish seam — the replica's
+    beat() wrapper downgrades it to a skipped beat, which the balancer
+    then reads as staleness."""
+    kv = InMemoryKV()
+    faults.arm("serving.replica_heartbeat", "error")
+    with pytest.raises(faults.InjectedFault):
+        publish_heartbeat(kv, NAMESPACE, "r0", {"seq": 1, "ts": 0.0})
+    faults.disarm()
+    publish_heartbeat(kv, NAMESPACE, "r0", {"seq": 2, "ts": 0.0})
+    assert read_heartbeats(kv, NAMESPACE)["r0"]["seq"] == 2
+
+
+# ----------------------------------------------------------------- cascade
+
+
+def test_fit_temperature_improves_calibration():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(512, 6) * 5.0  # overconfident
+    labels = (logits + rng.randn(512, 6) * 2.0).argmax(-1)
+    temperature = cascade_lib.fit_temperature(logits, labels)
+    assert temperature > 1.0  # overconfident logits must be softened
+    assert cascade_lib.nll(logits, labels, temperature) < cascade_lib.nll(
+        logits, labels, 1.0
+    )
+
+
+def test_pick_threshold_meets_target_or_degrades_to_fallthrough():
+    conf = np.array([0.3, 0.5, 0.7, 0.9, 0.95])
+    agree = np.array([False, True, True, True, True])
+    record = cascade_lib.pick_threshold(conf, agree, 0.99)
+    assert record["threshold"] == 0.5
+    assert record["holdout_agreement"] == 1.0
+    assert record["holdout_fallthrough_rate"] == pytest.approx(0.2)
+    # Unachievable target: the threshold must be unreachable even by a
+    # serve-time row MORE confident than anything in the holdout (a
+    # saturated softmax maxes at 1.0) — always-fall-through, and the
+    # record stays strict-JSON (no Infinity).
+    hopeless = cascade_lib.pick_threshold(
+        conf, np.zeros(5, bool), 0.5
+    )
+    assert hopeless["threshold"] == 2.0
+    assert hopeless["holdout_fallthrough_rate"] == 1.0
+    saturated = {"y": np.array([[1000.0, -1000.0]])}
+    assert not cascade_lib.clears(
+        dict(hopeless, temperature=1.0, logits_key="y"),
+        saturated,
+        real_rows=1,
+    )
+
+
+def test_cascade_clears_ignores_padding_rows():
+    record = {"temperature": 1.0, "threshold": 0.9, "logits_key": "y"}
+    confident = np.array([[10.0, -10.0]])
+    unsure = np.array([[0.1, 0.0]])
+    outputs = {"y": np.concatenate([confident, unsure])}
+    # Row 1 is padding: only the real row's confidence counts.
+    assert cascade_lib.clears(record, outputs, real_rows=1)
+    assert not cascade_lib.clears(record, outputs, real_rows=2)
+
+
+@pytest.fixture(scope="module")
+def cascade_model_dir(tmp_path_factory):
+    """One real cascade publication shared by the serve-time tests."""
+    import jax.numpy as jnp
+
+    model_dir = str(tmp_path_factory.mktemp("cascade-model"))
+    rng = np.random.RandomState(0)
+    hidden = rng.randn(16, 32).astype(np.float32)
+    head = rng.randn(32, 4).astype(np.float32)
+    keep = 28  # the cheap member: most of the ensemble, much cheaper
+
+    def full_fn(features):
+        return {"predictions": jnp.tanh(features["x"] @ hidden) @ head}
+
+    def cheap_fn(features):
+        return {
+            "predictions": jnp.tanh(features["x"] @ hidden[:, :keep])
+            @ head[:keep]
+        }
+
+    publisher.publish_generation(
+        model_dir,
+        0,
+        full_fn,
+        {"x": np.zeros((4, 16), np.float32)},
+        cascade=CascadeSpec(
+            cheap_fn,
+            {"x": rng.randn(512, 16).astype(np.float32)},
+            target_agreement=0.98,
+        ),
+    )
+    return model_dir
+
+
+def test_cascade_publication_signature_and_gate(cascade_model_dir):
+    from adanet_tpu.core import export as export_lib
+
+    gen = publisher.generation_dir(cascade_model_dir, 0)
+    assert os.path.exists(os.path.join(gen, export_lib.CASCADE_FILE))
+    signature = export_lib.serving_signature(gen)
+    cascade = signature["cascade"]
+    assert cascade["program"] == export_lib.CASCADE_FILE
+    assert cascade["temperature"] > 0
+    assert 0.0 < cascade["threshold"] <= 1.0
+    assert cascade["holdout_agreement"] >= 0.98
+    pool = ModelPool(cascade_model_dir)
+    assert pool.poll()
+    record = pool.active_record()
+    assert record.cascade_program is not None
+    assert record.cascade["threshold"] == cascade["threshold"]
+
+
+def test_cascade_fallthrough_bit_identical_to_full_oracle(
+    cascade_model_dir,
+):
+    """The acceptance property: fallthrough answers are bit-identical
+    to a cascade-free server, and cheap answers really come from the
+    cheap tier (cascade_level tags them)."""
+    pool = ModelPool(cascade_model_dir)
+    pool.poll()
+    rng = np.random.RandomState(7)
+    on = Batcher(pool, BatcherConfig(bucket_sizes=(4, 8)))
+    off = Batcher(pool, BatcherConfig(bucket_sizes=(4, 8), cascade=False))
+    record = pool.active_record()
+    saw_cheap = saw_fall = False
+    for _ in range(40):
+        x = {"x": rng.randn(2, 16).astype(np.float32)}
+        _, answered = on.execute([x])
+        _, oracle = off.execute([x])
+        assert off.last_cascade_level is None
+        if on.last_cascade_level == 1:
+            saw_fall = True
+            np.testing.assert_array_equal(
+                np.asarray(answered[0]["predictions"]),
+                np.asarray(oracle[0]["predictions"]),
+            )
+        else:
+            assert on.last_cascade_level == 0
+            saw_cheap = True
+            cheap_oracle = record.cascade_program(
+                np.asarray(x["x"], np.float32)
+                if not isinstance(x, dict)
+                else {"x": np.concatenate([x["x"], np.zeros((2, 16), np.float32)])}
+            )
+            np.testing.assert_array_equal(
+                np.asarray(answered[0]["predictions"]),
+                np.asarray(cheap_oracle["predictions"])[:2],
+            )
+    assert saw_fall, "threshold never fell through in 40 batches"
+    assert saw_cheap, "threshold never cleared in 40 batches"
+
+
+def test_cascade_level_reaches_serve_result(cascade_model_dir):
+    pool = ModelPool(cascade_model_dir)
+    pool.poll()
+    frontend = ServingFrontend(
+        Batcher(pool, BatcherConfig(bucket_sizes=(4, 8))),
+        FrontendConfig(default_deadline_secs=30.0),
+    ).start()
+    try:
+        result = frontend.submit(
+            {"x": np.zeros((2, 16), np.float32)}, timeout=60.0
+        )
+        assert result.ok
+        assert result.cascade_level in (0, 1)
+    finally:
+        frontend.drain(timeout=10.0)
+
+
+# ----------------------------------------------------------- servectl CLI
+
+
+def test_servectl_launch_status_drain_exit_contract(tmp_path, capsys):
+    """The operator loop end to end with the 0/1/2/64 contract shared
+    with ckpt_fsck/fleetctl."""
+    import jax.numpy as jnp
+
+    from tools import servectl
+
+    fleet_dir = str(tmp_path / "fleet")
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 4).astype(np.float32)
+    publisher.publish_generation(
+        model_dir,
+        0,
+        lambda f: {"predictions": jnp.tanh(f["x"] @ w)},
+        {"x": np.zeros((2, 16), np.float32)},
+    )
+    # Usage errors are EX_USAGE.
+    with pytest.raises(SystemExit) as excinfo:
+        servectl.main(["launch", fleet_dir])  # --model-dir missing
+    assert excinfo.value.code == 64
+    # No fleet yet: status is unusable.
+    assert servectl.main(["status", fleet_dir, "--json"]) == 2
+    capsys.readouterr()
+    try:
+        assert (
+            servectl.main(
+                [
+                    "launch",
+                    fleet_dir,
+                    "--model-dir",
+                    model_dir,
+                    "--replicas",
+                    "2",
+                    "--json",
+                ]
+            )
+            == 0
+        )
+        launch_report = json.loads(capsys.readouterr().out)
+        assert launch_report["missing_heartbeats"] == []
+        assert servectl.main(["status", fleet_dir, "--json"]) == 0
+        status = json.loads(capsys.readouterr().out)
+        assert status["consistent_generation"] is True
+        assert all(
+            entry["state"] == "serving"
+            for entry in status["replicas"].values()
+        )
+    finally:
+        rc = servectl.main(["drain", fleet_dir, "--json"])
+    assert rc == 0
+    drained = json.loads(capsys.readouterr().out)
+    assert sorted(drained["drained"]) == ["r0", "r1"]
+    # Everything exited: the census is now empty -> unusable.
+    assert servectl.main(["status", fleet_dir, "--json"]) == 2
+
+
+# ------------------------------------------------- the chaos gate (tentpole)
+
+
+def _spawn_replica(fleet_dir, model_dir, replica_id, env_extra=None):
+    from tools import servectl
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_DIR, env.get("PYTHONPATH", "")]
+    )
+    env.pop("ADANET_FAULTS", None)
+    env.update(env_extra or {})
+    return servectl.spawn_replica(
+        fleet_dir,
+        model_dir,
+        replica_id,
+        env=env,
+        heartbeat_interval=0.1,
+        heartbeat_stale=1.0,
+    )
+
+
+def _read_log(fleet_dir, replica_id):
+    path = os.path.join(fleet_dir, "logs", replica_id + ".log")
+    try:
+        with open(path) as f:
+            return f.read()[-4000:]
+    except OSError:
+        return "<no log>"
+
+
+def test_fleet_flip_sigkill_chaos_gate(tmp_path):
+    """THE acceptance gate: a 3-replica fleet under closed-loop traffic
+    survives SIGKILL of one replica mid-fleet-flip with zero dropped
+    requests (`error` count == 0; shed-and-retry allowed), ends with
+    every live replica serving the same generation (the respawned
+    victim completes the flip at bootstrap), and the shared artifact
+    store is fsck-clean after multi-process lease pinning."""
+    import jax.numpy as jnp
+
+    from adanet_tpu.store import ArtifactStore, fsck_store
+
+    fleet_dir = str(tmp_path / "fleet")
+    model_dir = os.path.join(fleet_dir, "model")
+    store_root = os.path.join(fleet_dir, "store")
+    os.makedirs(model_dir)
+    store = ArtifactStore(store_root)
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(16, 4).astype(np.float32)
+    sample = {"x": np.zeros((2, 16), np.float32)}
+    publisher.publish_generation(
+        model_dir,
+        0,
+        lambda f: {"predictions": jnp.tanh(f["x"] @ w0)},
+        sample,
+        store=store,
+    )
+
+    procs = {}
+    victim = "r2"
+    for rid in ("r0", "r1"):
+        procs[rid] = _spawn_replica(fleet_dir, model_dir, rid)
+    procs[victim] = _spawn_replica(
+        fleet_dir,
+        model_dir,
+        victim,
+        env_extra={"ADANET_FAULTS": "serving.fleet_flip:kill"},
+    )
+    kv = FileKV(os.path.join(fleet_dir, "kv"))
+    balancer = FleetBalancer(
+        kv, config=BalancerConfig(stale_after_secs=1.0)
+    )
+    results = []
+    results_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(seed):
+        client_rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            x = {
+                "x": client_rng.randn(
+                    client_rng.randint(1, 3), 16
+                ).astype(np.float32)
+            }
+            result = balancer.submit(x, deadline_secs=15.0)
+            with results_lock:
+                results.append(result)
+
+    threads = [
+        threading.Thread(target=client, args=(seed,), daemon=True)
+        for seed in range(3)
+    ]
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            beats = read_heartbeats(kv, NAMESPACE)
+            if len(beats) == 3 and all(
+                p.get("generation") == 0 for p in beats.values()
+            ):
+                break
+            dead = [r for r, p in procs.items() if p.poll() is not None]
+            assert not dead, "\n".join(
+                _read_log(fleet_dir, r) for r in dead
+            )
+            time.sleep(0.1)
+        else:
+            pytest.fail(
+                "fleet never bootstrapped: %r\n%s"
+                % (
+                    {
+                        r: p.get("generation")
+                        for r, p in read_heartbeats(kv, NAMESPACE).items()
+                    },
+                    "\n".join(_read_log(fleet_dir, r) for r in procs),
+                )
+            )
+        for thread in threads:
+            thread.start()
+        # Let traffic flow, then publish generation 1: the victim's
+        # armed `serving.fleet_flip:kill` SIGKILLs it the moment it
+        # begins participating in the coordinated flip.
+        time.sleep(1.0)
+        publisher.publish_generation(
+            model_dir,
+            1,
+            lambda f: {"predictions": jnp.tanh(f["x"] @ (w0 * 1.5))},
+            sample,
+            store=store,
+        )
+        deadline = time.time() + 120
+        while time.time() < deadline and procs[victim].poll() is None:
+            time.sleep(0.05)
+        assert procs[victim].poll() == -signal.SIGKILL, _read_log(
+            fleet_dir, victim
+        )
+        # The survivors must commit the flip without the victim
+        # (heartbeat staleness drops it from the required set).
+        while time.time() < deadline:
+            beats = read_heartbeats(kv, NAMESPACE)
+            if all(
+                beats.get(r, {}).get("generation") == 1
+                for r in ("r0", "r1")
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(
+                "survivors never flipped: %r\n%s\n%s"
+                % (
+                    {
+                        r: p.get("generation")
+                        for r, p in read_heartbeats(kv, NAMESPACE).items()
+                    },
+                    _read_log(fleet_dir, "r0"),
+                    _read_log(fleet_dir, "r1"),
+                )
+            )
+        # Respawn the victim clean: bootstrap must resolve the
+        # committed generation — the flip completes at respawn.
+        procs[victim] = _spawn_replica(fleet_dir, model_dir, victim)
+        while time.time() < deadline:
+            beats = read_heartbeats(kv, NAMESPACE)
+            if beats.get(victim, {}).get("generation") == 1:
+                break
+            assert procs[victim].poll() is None, _read_log(
+                fleet_dir, victim
+            )
+            time.sleep(0.1)
+        else:
+            pytest.fail(
+                "respawned victim never converged: %s"
+                % _read_log(fleet_dir, victim)
+            )
+        # A few more requests that must be answered by generation 1.
+        for _ in range(5):
+            result = balancer.submit(
+                {"x": rng.randn(2, 16).astype(np.float32)},
+                deadline_secs=15.0,
+            )
+            with results_lock:
+                results.append(result)
+    finally:
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=30.0)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # Zero dropped requests: every submit resolved, none as the
+    # 5xx-equivalent. Shed-and-retry is allowed and expected — the
+    # balancer's retry path is what absorbed the SIGKILL.
+    assert results
+    statuses = collections.Counter(r.status for r in results)
+    assert statuses.get("error", 0) == 0, statuses
+    assert statuses["ok"] > 0
+    oks = [r for r in results if r.ok]
+    assert {r.generation for r in oks} <= {0, 1}
+    assert [r.generation for r in oks][-1] == 1
+    # The flip was all-or-none: one commit outcome, no aborts.
+    outcomes = [
+        json.loads(v)
+        for k, v in kv.scan("%s/flip/" % NAMESPACE).items()
+        if k.endswith("/outcome")
+    ]
+    assert len(outcomes) == 1 and outcomes[0]["decision"] == "commit"
+    # The shared store survived three processes' lease pinning: clean
+    # fsck via the library and via the operator CLI.
+    audit = fsck_store(ArtifactStore(store_root))
+    assert audit["clean"], audit
+    from tools import ckpt_fsck
+
+    assert (
+        ckpt_fsck.main([model_dir, "--json", "--store", store_root]) == 0
+    )

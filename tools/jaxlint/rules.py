@@ -890,6 +890,17 @@ class HostModuleJnpRule(Rule):
         "serving/frontend.py",
         "serving/model_pool.py",
         "serving/publisher.py",
+        # The fleet's coordination/routing plane (heartbeats, p2c
+        # balancing, flip claims, cascade thresholding over host
+        # arrays, the wire codec) runs between device dispatches;
+        # device work stays inside the batcher's programs.
+        "serving/fleet/__init__.py",
+        "serving/fleet/replica.py",
+        "serving/fleet/balancer.py",
+        "serving/fleet/flip_coordinator.py",
+        "serving/fleet/cascade.py",
+        "serving/fleet/transport.py",
+        "tools/servectl.py",
         # The fleet's policy layer (trial specs, rung state machine,
         # graft planning) runs between searches; only
         # fleet/comparator.py traces device programs.

@@ -143,10 +143,11 @@ def write_json(model_dir: str, filename: str, obj) -> str:
 def read_json(model_dir: str, filename: str):
     """Reads a JSON artifact written by `write_json`; None when absent."""
     path = os.path.join(model_dir, filename)
-    if not os.path.exists(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
         return None
-    with open(path) as f:
-        return json.load(f)
 
 
 # ------------------------------------------------------------- integrity ops
@@ -212,15 +213,16 @@ def verify_file(
     missing file is False.
     """
     path = os.path.join(model_dir, filename)
-    if not os.path.exists(path):
-        return False
     expected = expected or read_digest(model_dir, filename)
+    digest = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                digest.update(chunk)
+    except FileNotFoundError:
+        return False
     if expected is None:
         return None
-    digest = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            digest.update(chunk)
     return digest.hexdigest() == expected
 
 

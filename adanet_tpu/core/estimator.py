@@ -2471,6 +2471,18 @@ class Estimator:
                 self._store_lease_ttl_secs(),
                 add_digests=digests,
             )
+        except store_leases.LeaseExpiredError:
+            # The pin lapsed (long compile, stalled host); GC may have
+            # swept in the gap, so re-acquire the full closure rather
+            # than resurrecting the dead lease.
+            self._store_lease = store_leases.acquire(
+                self._artifact_store,
+                owner="search-%d" % os.getpid(),
+                ttl_secs=self._store_lease_ttl_secs(),
+                digests=sorted(
+                    set(self._store_lease.digests) | set(digests)
+                ),
+            )
         except OSError as exc:
             _LOG.warning("Store lease renewal failed: %s", exc)
 

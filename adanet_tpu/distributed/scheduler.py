@@ -57,6 +57,7 @@ from adanet_tpu.distributed.executor import (
 from adanet_tpu.observability import metrics as metrics_lib
 from adanet_tpu.observability import spans as spans_lib
 from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.sched import sched_point
 from adanet_tpu.robustness.watchdog import (
     PeerLostError,
     collective_timeout_secs,
@@ -725,6 +726,11 @@ class WorkQueue:
                 # workers' drain-timeout PeerLostError — not a poison.
             token_key = self._key("claim", unit.uid, attempt)
             if self._kv.set(token_key, self._claim_token(), overwrite=False):
+                # Crash window: token won, lease not yet on record — the
+                # token's own deadline is what makes a death here
+                # recoverable (schedcheck crashes an actor exactly at
+                # this point to prove it).
+                sched_point("wq.claim_token_won")
                 self._write_lease(unit, attempt)
                 return attempt
             lease = self._lease(unit)
@@ -767,6 +773,10 @@ class WorkQueue:
                 "lease on %s (attempt %d) re-issued to %s"
                 % (unit.uid, attempt, lease and lease.get("owner"))
             )
+        # Race window: the ownership check above against the write
+        # below — a re-issue landing in between is legal (the set-once
+        # done/ marker arbitrates) and schedcheck explores it.
+        sched_point("wq.renew_checked")
         self._write_lease(unit, attempt)
         self._m_renewals.inc()
 
@@ -792,6 +802,9 @@ class WorkQueue:
                     blob[i * _KV_CHUNK_BYTES : (i + 1) * _KV_CHUNK_BYTES],
                 )
             self._kv.set("%s/n" % prefix, str(nchunks))
+        # Crash window: payload chunks on record, done/ marker not yet —
+        # readers must never observe this as complete.
+        sched_point("wq.complete_before_done")
         won = self._kv.set(
             self._key("done", unit.uid),
             json.dumps({"owner": self.worker, "attempt": attempt}),

@@ -50,6 +50,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.sched import sched_point
 from adanet_tpu.serving import publisher
 from adanet_tpu.serving.model_pool import GateError, gate_generation
 
@@ -280,6 +281,10 @@ class FlipParticipant:
         while True:
             token = _json(self._kv.try_get(keys.lead(attempt)))
             if token is None:
+                # Race window: the absent-token read above vs the
+                # set-once claim below — two replicas both reach here
+                # and the claim must elect exactly one.
+                sched_point("flip.lead_claim")
                 won = self._kv.set(
                     keys.lead(attempt),
                     json.dumps(
@@ -402,6 +407,10 @@ class FlipParticipant:
         reason: str,
         participants: Optional[List[str]] = None,
     ) -> Optional[str]:
+        # Race window: concurrent leaders (successor after an expired
+        # token) may both reach the outcome write; the set-once claim
+        # must yield exactly one fleet-wide decision.
+        sched_point("flip.decide_write")
         won = self._kv.set(
             keys.outcome,
             json.dumps(

@@ -536,12 +536,25 @@ class ModelPool:
                     digests=sorted(digests),
                 )
             else:
-                store_leases.renew(
-                    self._store,
-                    self._store_lease,
-                    self._store_lease_ttl,
-                    add_digests=digests,
-                )
+                try:
+                    store_leases.renew(
+                        self._store,
+                        self._store_lease,
+                        self._store_lease_ttl,
+                        add_digests=digests,
+                    )
+                except store_leases.LeaseExpiredError:
+                    # The pin lapsed (stalled poller); GC may have swept
+                    # in the gap, so re-acquire the full closure rather
+                    # than resurrecting the dead lease.
+                    self._store_lease = store_leases.acquire(
+                        self._store,
+                        owner="serving-%d" % os.getpid(),
+                        ttl_secs=self._store_lease_ttl,
+                        digests=sorted(
+                            set(self._store_lease.digests) | set(digests)
+                        ),
+                    )
         except Exception:
             _LOG.exception(
                 "Store lease pin for generation %d failed; serving "

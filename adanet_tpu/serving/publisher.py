@@ -90,6 +90,7 @@ def write_generation_manifest(gen_dir: str, iteration_number: int) -> None:
             continue
         if name == integrity.GENERATION_MANIFEST:
             continue
+        # jaxlint: disable=JL019(gen_dir is the publisher's private mkdtemp staging dir until the atomic os.replace below; no concurrent writer exists before publication)
         with open(path, "rb") as f:
             data = f.read()
         digests[name] = ckpt.write_digest(gen_dir, name, data)

@@ -35,6 +35,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from adanet_tpu.observability import spans as spans_lib
 from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.sched import sched_point
 from adanet_tpu.robustness.retry import with_retries
 from adanet_tpu.store import keys
 
@@ -426,6 +427,9 @@ class ArtifactStore:
                 json.dump(doc, f, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
+            # Race window: the absent-ref read above vs the claim below
+            # — two writers both staged; the link must elect one doc.
+            sched_point("ref.link_claim")
             try:
                 os.link(tmp, final)  # the set-once claim
             except OSError as exc:

@@ -28,6 +28,7 @@ import os
 from typing import List, Optional
 
 from adanet_tpu.robustness import faults
+from adanet_tpu.robustness.sched import sched_point
 from adanet_tpu.store import leases as leases_lib
 
 _LOG = logging.getLogger("adanet_tpu")
@@ -94,6 +95,11 @@ def collect(
                 leases_lib.release(store, lease)
 
     # ---- sweep blobs.
+    # Race window: the mark snapshot above vs the sweep below — a lease
+    # acquired/renewed in between must still protect its blobs (the
+    # snapshot-before-sweep ordering plus the grace window make a stale
+    # mark safe; schedcheck explores exactly this interleaving).
+    sched_point("gc.mark_done")
     for digest, path in store.iter_blobs():
         report.scanned_blobs += 1
         if digest in referenced:
@@ -111,6 +117,20 @@ def collect(
             continue
         report.would_remove.append(digest)
         if not dry_run:
+            sched_point("gc.before_unlink")
+            # Re-check pins at the unlink: the mark snapshot can be
+            # arbitrarily stale by now, and a lease (re-)acquired
+            # mid-pass — a holder recovering from LeaseExpiredError —
+            # must still protect its blobs. Lease files are few; the
+            # re-read is cheap next to the unlink it guards.
+            if any(
+                digest in lease.digests
+                for lease in leases_lib.iter_leases(store)
+                if lease.expires_at > now
+            ):
+                report.would_remove.pop()
+                report.pinned += 1
+                continue
             try:
                 os.unlink(path)
             except OSError:

@@ -23,7 +23,19 @@ import tempfile
 import uuid
 from typing import Iterable, List, Optional
 
+from adanet_tpu.robustness import sched
+
 _LOG = logging.getLogger("adanet_tpu")
+
+
+class LeaseExpiredError(RuntimeError):
+    """Raised on `renew` of a lease whose TTL has already elapsed.
+
+    Once expired, GC is free to sweep the pinned blobs — silently
+    extending the expiry would retroactively "un-expire" the lease and
+    hide the protection gap from the holder. The holder must re-acquire
+    (and may re-verify its artifacts) instead.
+    """
 
 
 @dataclasses.dataclass
@@ -106,9 +118,21 @@ def renew(
     The closure only ever grows within one lease lifetime: dropping a
     pin is `release` + fresh `acquire`, so a renew racing GC can never
     shrink the protected set mid-scan.
+
+    Raises `LeaseExpiredError` if the TTL already elapsed: GC may have
+    swept the pinned blobs in the gap, so extending the expiry would
+    resurrect a dead pin and hide the protection gap from the holder.
     """
+    now = float(store.clock())
+    if now > lease.expires_at:
+        raise LeaseExpiredError(
+            "Lease %s (owner %s) expired at %.3f (now %.3f); "
+            "re-acquire instead of renewing — GC may have reclaimed "
+            "its blobs." % (lease.lease_id, lease.owner, lease.expires_at, now)
+        )
     lease.digests = sorted(set(lease.digests) | set(add_digests))
-    lease.expires_at = float(store.clock()) + float(ttl_secs)
+    lease.expires_at = now + float(ttl_secs)
+    sched.sched_point("lease.renew_write")
     _write_lease(store, lease)
     return lease
 

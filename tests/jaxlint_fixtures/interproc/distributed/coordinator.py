@@ -1,0 +1,12 @@
+"""JL017 interproc seed: the raw coordination overwrite is two calls
+below the entry, across a module boundary.
+
+`finalize_sweep` is the exposed entry (no callers, no guard); the
+actual `kv.set` lives in `kvops._raw_set`. The engine must attribute
+the full chain in the finding message.
+"""
+from tests.jaxlint_fixtures.interproc.distributed import kvops
+
+
+def finalize_sweep(kv, decision):
+    kvops.record_outcome(kv, decision)

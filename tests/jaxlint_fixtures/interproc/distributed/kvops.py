@@ -1,0 +1,9 @@
+"""Helpers for coordinator.py: the buried raw set lives here."""
+
+
+def record_outcome(kv, decision):
+    _raw_set(kv, decision)
+
+
+def _raw_set(kv, payload):
+    kv.set("sweep/outcome", payload)  # JL017: raw overwrite, 2 frames down

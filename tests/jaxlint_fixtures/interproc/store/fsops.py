@@ -1,0 +1,11 @@
+"""Filesystem helpers for sweeper.py: the buried check-then-use."""
+import os
+
+
+def purge(path):
+    _unlink_checked(path)
+
+
+def _unlink_checked(path):
+    if os.path.exists(path):
+        os.unlink(path)  # JL019: TOCTOU, 2 frames below the entry

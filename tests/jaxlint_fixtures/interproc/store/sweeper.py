@@ -1,0 +1,9 @@
+"""JL019 interproc seed: the TOCTOU unlink is two calls below the
+entry, across a module boundary (sweep -> purge -> _unlink_checked).
+"""
+from tests.jaxlint_fixtures.interproc.store import fsops
+
+
+def sweep(root, names):
+    for name in names:
+        fsops.purge(root + "/" + name)

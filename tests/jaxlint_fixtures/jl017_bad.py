@@ -1,0 +1,28 @@
+"""JL017 bad: raw overwrites of coordination keys (lost-update races).
+
+Linted under a virtual `adanet_tpu/distributed/` path — JL017 scopes to
+the coordination modules.
+"""
+
+
+class Coordinator:
+    def __init__(self, kv, worker):
+        self._kv = kv
+        self.worker = worker
+
+    def publish_outcome(self, decision):
+        # A shared decision cell written with the overwriting default:
+        # two concurrent deciders both "win".
+        self._kv.set("flip/outcome", decision)  # expect: JL017
+
+    def bump_epoch(self, value):
+        self._kv.set("epoch/current", value, overwrite=True)  # expect: JL017
+
+
+def _record_result(kv, payload):
+    # Buried one call below an unguarded entry: the chain is attributed.
+    kv.set("sweep/result", payload)  # expect: JL017
+
+
+def finish_sweep(kv, payload):
+    _record_result(kv, payload)

@@ -1,0 +1,21 @@
+"""JL017 good: every coordination write uses a sanctioned idiom."""
+
+
+class Coordinator:
+    def __init__(self, kv, worker):
+        self._kv = kv
+        self.worker = worker
+
+    def claim_outcome(self, decision):
+        # Set-once claim: the insert-if-absent primitive.
+        return self._kv.set("flip/outcome", decision, overwrite=False)
+
+    def heartbeat(self, stamp):
+        # Single-writer key: embeds the writer's own identity.
+        self._kv.set("heartbeat/%s" % self.worker, stamp)
+
+    def renew_lease(self, lease, stamp):
+        # Ownership check before the overwrite: only the holder renews.
+        if lease["owner"] != self.worker:
+            raise RuntimeError("lease re-issued")
+        self._kv.set("lease/current", stamp)

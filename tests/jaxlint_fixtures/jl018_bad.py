@@ -1,0 +1,19 @@
+"""JL018 bad: one attribute written from both thread roles, no lock."""
+import threading
+
+
+class Renewer:
+    def __init__(self):
+        self._beats = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        # Background role: reachable from the Thread target.
+        self._beats += 1  # expect: JL018
+
+    def reset(self):
+        # Main role writes the same attribute; no common lock exists.
+        self._beats = 0

@@ -1,0 +1,29 @@
+"""JL018 good: cross-thread writes share a lock; single-writer publish
+(background writes, main only reads) is exempt."""
+import threading
+
+
+class Renewer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beats = 0
+        self._lost = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        with self._lock:
+            self._beats += 1
+        # Single-writer publish: only the background thread ever writes
+        # this flag; the main thread just reads it (legal under the GIL).
+        self._lost = True
+
+    def reset(self):
+        with self._lock:
+            self._beats = 0
+
+    @property
+    def lost(self):
+        return self._lost

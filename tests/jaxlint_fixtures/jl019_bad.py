@@ -1,0 +1,22 @@
+"""JL019 bad: check-then-use filesystem races in a store module.
+
+Linted under a virtual `adanet_tpu/store/` path — JL019 scopes to the
+coordination/persistence dirs.
+"""
+import os
+
+
+def remove_stale(path):
+    if os.path.exists(path):
+        # The file can vanish between the check and the unlink.
+        os.unlink(path)  # expect: JL019
+
+
+def read_all(root):
+    out = []
+    names = os.listdir(root)
+    for name in names:
+        full = os.path.join(root, name)
+        with open(full) as f:  # expect: JL019
+            out.append(f.read())
+    return out

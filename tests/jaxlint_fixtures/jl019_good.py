@@ -1,0 +1,25 @@
+"""JL019 good: operate-and-handle instead of check-then-use."""
+import os
+
+
+def remove_stale(path):
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass  # already gone: exactly what we wanted
+
+
+def read_all(root):
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        full = os.path.join(root, name)
+        try:
+            with open(full) as f:
+                out.append(f.read())
+        except OSError:
+            continue  # entry vanished between list and open: skip it
+    return out

@@ -1,0 +1,28 @@
+"""JL020 bad: clock-domain mixing and a dropped deadline."""
+import time
+
+
+def wait_for(ready, ttl_secs):
+    deadline = time.time() + ttl_secs
+    while not ready():
+        if time.monotonic() > deadline:  # expect: JL020
+            raise TimeoutError("wait_for")
+
+
+class Lease:
+    def __init__(self, clock=time.time):
+        self._clock = clock
+
+    def remaining(self, ttl_secs):
+        started = time.monotonic()
+        return self._clock() - started + ttl_secs  # expect: JL020
+
+
+def _fetch(kv, key, timeout_secs=30.0):
+    return kv.get(key, timeout_secs)
+
+
+def read_result(kv, key, timeout_secs):
+    # Takes a deadline but calls the bounded helper without one: the
+    # caller's budget is silently replaced by the helper's default.
+    return _fetch(kv, key)  # expect: JL020

@@ -1,0 +1,26 @@
+"""JL020 good: one clock domain per deadline, deadlines forwarded."""
+import time
+
+
+def wait_for(ready, ttl_secs):
+    deadline = time.monotonic() + ttl_secs
+    while not ready():
+        if time.monotonic() > deadline:
+            raise TimeoutError("wait_for")
+
+
+class Lease:
+    def __init__(self, clock=time.time):
+        self._clock = clock
+
+    def remaining(self, started, ttl_secs):
+        # Injected-clock domain on BOTH sides of the arithmetic.
+        return started + ttl_secs - self._clock()
+
+
+def _fetch(kv, key, timeout_secs=30.0):
+    return kv.get(key, timeout_secs)
+
+
+def read_result(kv, key, timeout_secs):
+    return _fetch(kv, key, timeout_secs)

@@ -37,13 +37,15 @@ from tools.jaxlint.engine import load_baseline, write_baseline
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "jaxlint_fixtures")
 
-# JL006/JL007/JL013/JL015 key on module paths; their fixtures are linted
-# under a virtual path that puts them in scope.
+# JL006/JL007/JL013/JL015/JL017/JL019 key on module paths; their
+# fixtures are linted under a virtual path that puts them in scope.
 VIRTUAL_PATHS = {
     "JL006": "adanet_tpu/core/checkpoint.py",
     "JL007": "adanet_tpu/distributed/executor.py",
     "JL013": "adanet_tpu/store/fixture_writer.py",
     "JL015": "adanet_tpu/robustness/faults.py",
+    "JL017": "adanet_tpu/distributed/fixture_coord.py",
+    "JL019": "adanet_tpu/store/fixture_sweep.py",
 }
 
 _EXPECT_RE = re.compile(r"#\s*expect:\s*(JL\d{3})")
@@ -82,7 +84,8 @@ def test_good_fixture_is_clean(rule_id):
 
 
 def test_all_rule_packs_active():
-    assert len(ALL_RULES) >= 16  # core 9 + perf 4 + protocol 3
+    # core 9 + perf 4 + protocol 3 + concurrency 4
+    assert len(ALL_RULES) >= 20
     assert len({r.rule_id for r in ALL_RULES}) == len(ALL_RULES)
     assert all(r.summary for r in ALL_RULES)
     # The packs themselves.
@@ -94,6 +97,10 @@ def test_all_rule_packs_active():
         "JL014",
         "JL015",
         "JL016",
+        "JL017",
+        "JL018",
+        "JL019",
+        "JL020",
     ):
         assert rule_id in RULES_BY_ID
         assert RULES_BY_ID[rule_id].project
@@ -411,7 +418,14 @@ def test_interprocedural_chain_attribution():
     by_rule = {}
     for f in result["findings"]:
         by_rule.setdefault(f.rule, []).append(f)
-    assert sorted(by_rule) == ["JL002", "JL005", "JL010", "JL013"]
+    assert sorted(by_rule) == [
+        "JL002",
+        "JL005",
+        "JL010",
+        "JL013",
+        "JL017",
+        "JL019",
+    ]
 
     [sync] = by_rule["JL002"]
     assert sync.path.endswith("interproc/metrics.py")
@@ -436,6 +450,18 @@ def test_interprocedural_chain_attribution():
     assert write.path.endswith("interproc/store/writer.py")
     assert "_write_raw" in write.message
     assert "save" in write.message and "_persist" in write.message
+
+    # Concurrency pack (PR 16): a raw coordination overwrite and a
+    # filesystem TOCTOU, each buried two calls below the entry across a
+    # module boundary, with the whole chain in the message.
+    [overwrite] = by_rule["JL017"]
+    assert overwrite.path.endswith("interproc/distributed/kvops.py")
+    assert "finalize_sweep" in overwrite.message
+    assert "record_outcome" in overwrite.message
+
+    [toctou] = by_rule["JL019"]
+    assert toctou.path.endswith("interproc/store/fsops.py")
+    assert "sweep" in toctou.message and "purge" in toctou.message
 
 
 # ----------------------------------------------------- output determinism
@@ -469,9 +495,15 @@ def test_sweep_output_is_byte_identical_across_processes():
     first = _sweep_json(paths)
     second = _sweep_json(paths)
     assert first == second
-    # And it actually found things (the bad fixtures).
+    # And it actually found things (the bad fixtures) — including the
+    # concurrency pack: the interproc/{distributed,store} fixtures are
+    # in JL017/JL019 scope under their REAL paths, and JL018/JL020 are
+    # unscoped, so the byte-identity assertion above covers the new
+    # rules' messages (incl. chain attribution) too.
     parsed = json.loads(first)
     assert parsed["findings"], "fixture sweep found nothing"
+    rules_seen = {f["rule"] for f in parsed["findings"]}
+    assert {"JL017", "JL018", "JL019", "JL020"} <= rules_seen
 
 
 def test_sarif_output_shape():
@@ -494,7 +526,7 @@ def test_sarif_output_shape():
     assert doc["version"] == "2.1.0"
     run = doc["runs"][0]
     rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"JL002", "JL010", "JL013"} <= rule_ids
+    assert {"JL002", "JL010", "JL013", "JL017", "JL020"} <= rule_ids
     assert run["results"], "no SARIF results for a bad fixture"
     result = run["results"][0]
     assert result["ruleId"] == "JL004"
@@ -629,9 +661,111 @@ def test_new_rule_packs_have_no_baseline_debt():
         "JL014",
         "JL015",
         "JL016",
+        "JL017",
+        "JL018",
+        "JL019",
+        "JL020",
     }
     debt = [e for e in baseline["entries"] if e["rule"] in packs]
     assert debt == [], debt
+
+
+# ---------------------------------------------------------- --changed-only
+
+
+def test_changed_only_restricts_report_not_the_graph():
+    """`--changed-only` must scope the REPORT, not the analysis: a
+    finding in a changed file keeps its cross-file chain (the unchanged
+    entry module is still in the call graph), while findings in
+    unchanged files are filtered out."""
+    from tools.jaxlint.engine import run_paths as run
+
+    restricted = run(
+        [os.path.join(FIXTURES, "interproc")],
+        restrict_to=[
+            os.path.join(
+                FIXTURES, "interproc", "distributed", "kvops.py"
+            )
+        ],
+    )
+    [finding] = restricted["findings"]
+    assert finding.rule == "JL017"
+    assert finding.path.endswith("interproc/distributed/kvops.py")
+    # The chain still walks through the UNRESTRICTED coordinator.py —
+    # proof the whole-project graph was built.
+    assert "finalize_sweep" in finding.message
+    # Stale-baseline pruning is meaningless on a partial view.
+    assert restricted["unused_baseline"] == []
+
+
+def test_git_changed_files_tracks_worktree_and_untracked(tmp_path):
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+            + list(args),
+            cwd=str(tmp_path),
+            check=True,
+            capture_output=True,
+        )
+
+    from tools.jaxlint.engine import git_changed_files
+
+    # Not a repository (yet) -> RuntimeError, surfaced as exit 2 by the
+    # CLI. Checked before `git init`: afterwards every subdir is in it.
+    with pytest.raises(RuntimeError):
+        git_changed_files(str(tmp_path))
+
+    (tmp_path / "a.py").write_text("A = 1\n")
+    (tmp_path / "b.py").write_text("B = 1\n")
+    (tmp_path / "notes.txt").write_text("not python\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    assert git_changed_files(str(tmp_path)) == []
+
+    (tmp_path / "a.py").write_text("A = 2\n")  # worktree edit
+    (tmp_path / "c.py").write_text("C = 1\n")  # untracked
+    (tmp_path / "notes.txt").write_text("still not python\n")
+    assert git_changed_files(str(tmp_path)) == ["a.py", "c.py"]
+
+
+def test_changed_only_refuses_baseline_rewrites():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "tools.jaxlint",
+            "--changed-only",
+            "--update-baseline",
+            "adanet_tpu",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "cannot combine with baseline rewrites" in proc.stderr
+
+
+def test_changed_only_single_file_is_fast():
+    """The point of --changed-only: a one-file diff lints well under
+    the full-sweep budget (<5 s including the whole-repo call graph)."""
+    import time as _time
+
+    from tools.jaxlint.engine import run_paths as run
+
+    start = _time.monotonic()
+    result = run(
+        ["adanet_tpu", "tools"],
+        restrict_to=["adanet_tpu/store/gc.py"],
+    )
+    elapsed = _time.monotonic() - start
+    assert result["files"] > 50  # whole project still parsed
+    assert all(
+        f.path == "adanet_tpu/store/gc.py" for f in result["findings"]
+    )
+    assert elapsed < 5.0, "restricted sweep took %.1fs" % elapsed
 
 
 # ------------------------------------------------------------ the CI gate

@@ -21,6 +21,11 @@ Rules (see docs/jaxlint.md for bad/good pairs):
     JL013 non-atomic persistence writes (missing stage+fsync+rename)
     JL014 lock-order inversions (potential deadlock cycles)
     JL015 fault-site registry out of sync with trips / armed tests
+    -- concurrency pack (rules_concurrency.py) --
+    JL017 raw overwrites of coordination keys (lost-update races)
+    JL018 cross-thread attribute writes with no common lock
+    JL019 filesystem TOCTOU in coordination/persistence dirs
+    JL020 clock-domain mixing / dropped deadlines in wait chains
 
 Interprocedural rules run over a whole-repo call graph
 (`tools/jaxlint/callgraph.py`): imports (aliased), `self.`/class

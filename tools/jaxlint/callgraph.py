@@ -50,6 +50,21 @@ def dotted_name(node: ast.AST) -> Optional[str]:
     return None
 
 
+def module_walk(tree: ast.AST) -> Iterator[ast.AST]:
+    """`ast.walk(tree)` memoized on the module node.
+
+    Several rules and the graph builder each walk every full module
+    tree; the ASTs are immutable for the lifetime of a sweep, so the
+    flattened node list is computed once and cached on the tree.
+    """
+    try:
+        cached = tree._jaxlint_module_walk  # type: ignore[attr-defined]
+    except AttributeError:
+        cached = list(ast.walk(tree))
+        tree._jaxlint_module_walk = cached  # type: ignore[attr-defined]
+    return iter(cached)
+
+
 def is_jit_expr(node: ast.AST) -> bool:
     """True for an expression naming a jit-family transform."""
     name = dotted_name(node)
@@ -179,7 +194,7 @@ class CallGraph:
                     self._index_class(mod, node)
 
     def _index_imports(self, mod: ModuleInfo, tree: ast.Module) -> None:
-        for node in ast.walk(tree):
+        for node in module_walk(tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     local = alias.asname or alias.name.split(".")[0]
@@ -416,7 +431,7 @@ class CallGraph:
 
     def _collect_attr_wrappers(self, mod: ModuleInfo) -> None:
         ctx = self.files[mod.path]
-        for node in ast.walk(ctx.tree):
+        for node in module_walk(ctx.tree):
             if not isinstance(node, ast.Assign) or not isinstance(
                 node.value, ast.Call
             ):
@@ -464,7 +479,7 @@ class CallGraph:
         for path in sorted(self.files):
             mod = self.modules[path]
             ctx = self.files[path]
-            for node in ast.walk(ctx.tree):
+            for node in module_walk(ctx.tree):
                 if not isinstance(node, ast.Call) or not node.args:
                     continue
                 name = _dotted(node.func) or ""
@@ -509,12 +524,25 @@ def _directly_nested(outer: ast.AST, inner: ast.AST) -> bool:
 
 
 def _scope_nodes(func: ast.AST) -> Iterator[ast.AST]:
-    """Nodes of a function body, not descending into nested defs."""
-    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
-    while stack:
-        node = stack.pop()
-        yield node
-        if not isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        ):
-            stack.extend(ast.iter_child_nodes(node))
+    """Nodes of a function body, not descending into nested defs.
+
+    Memoized on the node (same cache the rules' `_scope_walk` uses):
+    graph construction and several rules each walk every function, and
+    the AST never mutates within a sweep.
+    """
+    cached = getattr(func, "_jaxlint_scope_nodes", None)
+    if cached is None:
+        cached = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            cached.append(node)
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+        try:
+            func._jaxlint_scope_nodes = cached
+        except AttributeError:
+            pass
+    return iter(cached)

@@ -25,6 +25,12 @@ them:
   never grow it silently.
 - Output: deterministic `text`, `json`, and `sarif` formats (two sweeps
   over the same tree are byte-identical — timings go to stderr only).
+- `--changed-only`: lints the same whole-repo project (interprocedural
+  rules need the full call graph to attribute chains correctly) but
+  runs file rules only over, and reports findings only in, the files
+  changed vs HEAD (worktree + index + untracked). The expensive part
+  of a sweep is per-file rule work, so a one-file diff lints in well
+  under the full-sweep budget.
 """
 
 from __future__ import annotations
@@ -158,10 +164,18 @@ def _finding_sort_key(f: Finding) -> Tuple:
 
 
 def _run_rules(
-    project: ProjectContext, rules: Sequence
+    project: ProjectContext,
+    rules: Sequence,
+    restrict: Optional[set] = None,
 ) -> Tuple[List[Finding], List[Finding], Dict[str, float]]:
     """Runs all rules over a project; returns (active, suppressed,
-    per-rule seconds). File rules run per file; project rules once."""
+    per-rule seconds). File rules run per file; project rules once.
+
+    `restrict` (normalized paths) scopes the REPORT, not the analysis:
+    file rules only visit restricted files (that's the speedup), while
+    project rules still analyze the whole project — their call graph
+    must see every caller — and only their findings are filtered.
+    """
     suppress_maps = {
         path: _suppressions(ctx.lines)
         for path, ctx in project.files.items()
@@ -176,11 +190,15 @@ def _run_rules(
             raw = list(rule.check_project(project))
         else:
             for path in sorted(project.files):
+                if restrict is not None and path not in restrict:
+                    continue
                 raw.extend(rule.check(project.files[path]))
         timings[rule.rule_id] = (
             timings.get(rule.rule_id, 0.0) + time.perf_counter() - start
         )
         for finding in raw:
+            if restrict is not None and finding.path not in restrict:
+                continue
             per_line, file_wide = suppress_maps.get(
                 finding.path, ({}, set())
             )
@@ -258,6 +276,7 @@ def run_paths(
     paths: Sequence[str],
     rules: Optional[Sequence] = None,
     baseline: Optional[Dict] = None,
+    restrict_to: Optional[Iterable[str]] = None,
 ) -> Dict:
     """Lints `paths` as ONE project; returns a result dict.
 
@@ -265,6 +284,11 @@ def run_paths(
     the gate), `baselined`, `suppressed`, `missing_paths`,
     `unused_baseline` (stale entries worth pruning), `files` (count),
     `timings` (rule id -> seconds, this run).
+
+    `restrict_to` (the --changed-only file set) limits file-rule work
+    and reported findings to those files; the project/call-graph still
+    covers every path, and `unused_baseline` is suppressed (an entry
+    outside the restricted set is not stale, just out of scope).
     """
     if rules is None:
         from tools.jaxlint.rules import ALL_RULES
@@ -275,8 +299,19 @@ def run_paths(
     for filename in files:
         with open(filename, "r", encoding="utf-8") as f:
             sources[_normalize(filename)] = f.read()
+    restrict = (
+        None
+        if restrict_to is None
+        else {_normalize(p) for p in restrict_to}
+    )
     project, parse_findings = build_project(sources)
-    active, all_suppressed, timings = _run_rules(project, rules)
+    if restrict is not None:
+        parse_findings = [
+            f for f in parse_findings if f.path in restrict
+        ]
+    active, all_suppressed, timings = _run_rules(
+        project, rules, restrict=restrict
+    )
     all_active = sorted(
         parse_findings + active, key=_finding_sort_key
     )
@@ -295,11 +330,15 @@ def run_paths(
             grandfathered.append(finding)
         else:
             new.append(finding)
-    unused = [
-        {"path": path, "rule": rule, "code": code, "count": count}
-        for (path, rule, code), count in sorted(budget.items())
-        if count > 0
-    ]
+    unused = (
+        []
+        if restrict is not None
+        else [
+            {"path": path, "rule": rule, "code": code, "count": count}
+            for (path, rule, code), count in sorted(budget.items())
+            if count > 0
+        ]
+    )
     return {
         "findings": new,
         "baselined": grandfathered,
@@ -323,6 +362,43 @@ def _normalize(path: str) -> str:
     if abs_path == _REPO_ROOT or abs_path.startswith(_REPO_ROOT + os.sep):
         abs_path = os.path.relpath(abs_path, _REPO_ROOT)
     return abs_path.replace(os.sep, "/")
+
+
+def git_changed_files(repo_root: Optional[str] = None) -> List[str]:
+    """Python files changed vs HEAD: worktree + index + untracked.
+
+    Returns repo-root-relative normalized paths. Raises RuntimeError
+    when git is unavailable or the tree is not a repository — the
+    caller decides whether that degrades to a full sweep or an error.
+    """
+    import subprocess
+
+    root = repo_root or _REPO_ROOT
+    changed: set = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                args,
+                cwd=root,
+                capture_output=True,
+                text=True,
+                timeout=30,
+                check=True,
+            )
+        except (OSError, subprocess.SubprocessError) as exc:
+            raise RuntimeError(
+                "--changed-only needs a git checkout: %s failed (%s)"
+                % (" ".join(args), exc)
+            )
+        changed.update(
+            line.strip()
+            for line in out.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    return sorted(changed)
 
 
 def default_baseline_path() -> str:
@@ -518,6 +594,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--format", choices=("text", "json", "sarif"), default="text"
     )
     parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help=(
+            "lint only files changed vs HEAD (worktree+index+"
+            "untracked); the whole-repo call graph is still built so "
+            "interprocedural findings keep their chains"
+        ),
+    )
+    parser.add_argument(
         "--timings",
         action="store_true",
         help="print per-rule sweep timing to stderr",
@@ -536,10 +621,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not args.paths:
         parser.error("the following arguments are required: paths")
 
+    restrict_to = None
+    if args.changed_only:
+        if args.write_baseline or args.update_baseline:
+            parser.error(
+                "--changed-only cannot combine with baseline rewrites "
+                "(the ratchet needs the full finding set)"
+            )
+        try:
+            restrict_to = git_changed_files()
+        except RuntimeError as exc:
+            print("jaxlint: error: %s" % exc, file=sys.stderr)
+            return 2
+        if not restrict_to:
+            print(
+                "jaxlint: --changed-only: no Python files changed vs "
+                "HEAD; nothing to lint",
+                file=sys.stderr,
+            )
+            return 0
+
     baseline = None
     if not (args.no_baseline or args.write_baseline or args.update_baseline):
         baseline = load_baseline(args.baseline)
-    result = run_paths(args.paths, rules=ALL_RULES, baseline=baseline)
+    result = run_paths(
+        args.paths,
+        rules=ALL_RULES,
+        baseline=baseline,
+        restrict_to=restrict_to,
+    )
 
     if args.timings:
         total = 0.0

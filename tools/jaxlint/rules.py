@@ -29,6 +29,7 @@ from tools.jaxlint.callgraph import (
     dotted_name,
     is_jit_expr as _is_jit_expr,
     jit_decorator_kwargs,
+    module_walk,
 )
 from tools.jaxlint.engine import FileContext, Finding, ProjectContext
 
@@ -38,9 +39,20 @@ from tools.jaxlint.engine import FileContext, Finding, ProjectContext
 def iter_functions(
     tree: ast.Module,
 ) -> Iterator[ast.FunctionDef]:
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
+    # Memoized on the module node: every rule that iterates functions
+    # re-walks the same immutable tree otherwise.
+    cached = getattr(tree, "_jaxlint_functions", None)
+    if cached is None:
+        cached = [
+            node
+            for node in module_walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        try:
+            tree._jaxlint_functions = cached
+        except AttributeError:
+            pass
+    return iter(cached)
 
 
 def jit_functions(ctx: FileContext) -> List[ast.FunctionDef]:
@@ -69,7 +81,7 @@ def jit_functions(ctx: FileContext) -> List[ast.FunctionDef]:
         ):
             add(func)
 
-    for node in ast.walk(ctx.tree):
+    for node in module_walk(ctx.tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
         func_name = dotted_name(node.func)
@@ -428,7 +440,7 @@ class RecompileHazardRule(Rule):
         # jit(lambda ...) built at call time: a fresh function identity
         # per call misses jax's jit cache, so every invocation re-pays
         # tracing AND XLA compilation — per candidate per iteration here.
-        for node in ast.walk(ctx.tree):
+        for node in module_walk(ctx.tree):
             if (
                 isinstance(node, ast.Call)
                 and _is_jit_expr(node.func)
@@ -592,7 +604,7 @@ class MissingDonationRule(Rule):
             mod = graph.modules.get(path)
             if mod is None:
                 continue
-            for node in ast.walk(ctx.tree):
+            for node in module_walk(ctx.tree):
                 if not isinstance(node, ast.Call) or not node.args:
                     continue
                 name = dotted_name(node.func) or ""
@@ -834,15 +846,30 @@ class KeyReuseRule(Rule):
 
 
 def _scope_walk(func: ast.FunctionDef) -> Iterator[ast.AST]:
-    """Walks a function body without descending into nested defs."""
-    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
-    while stack:
-        node = stack.pop()
-        yield node
-        if not isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        ):
-            stack.extend(ast.iter_child_nodes(node))
+    """Walks a function body without descending into nested defs.
+
+    The node list is memoized on the function node: a project sweep
+    walks every function once per rule that cares, and the repeated
+    `iter_child_nodes` traffic dominated sweep time before caching
+    (the AST is immutable for the lifetime of a sweep, so the cache
+    cannot go stale).
+    """
+    cached = getattr(func, "_jaxlint_scope_nodes", None)
+    if cached is None:
+        cached = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            cached.append(node)
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+        try:
+            func._jaxlint_scope_nodes = cached
+        except AttributeError:
+            pass  # nodes without __dict__ (never the case for defs)
+    return iter(cached)
 
 
 def _stored_names(node: ast.AST) -> Set[str]:
@@ -934,7 +961,7 @@ class HostModuleJnpRule(Rule):
         if not any(path.endswith(suffix) for suffix in self.HOST_ONLY):
             return []
         findings = []
-        for node in ast.walk(ctx.tree):
+        for node in module_walk(ctx.tree):
             if isinstance(node, (ast.Import, ast.ImportFrom)):
                 module = getattr(node, "module", None) or ""
                 names = [a.name for a in node.names]
@@ -994,7 +1021,7 @@ class UnshardedEntryRule(Rule):
         if not any(d in path for d in self._DIRS):
             return []
         findings = []
-        for node in ast.walk(ctx.tree):
+        for node in module_walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
             name = dotted_name(node.func)
@@ -1136,7 +1163,7 @@ class UnboundedWaitRule(Rule):
 
     def _check_sites(self, ctx: FileContext) -> List[Finding]:
         findings = []
-        for node in ast.walk(ctx.tree):
+        for node in module_walk(ctx.tree):
             if not isinstance(node, ast.Call) or not isinstance(
                 node.func, ast.Attribute
             ):
@@ -1275,10 +1302,11 @@ CORE_RULES: List[Rule] = [
 def _all_rules() -> List[Rule]:
     # The packs import from this module; aggregate lazily to keep the
     # import graph acyclic (rules_perf/rules_protocol -> rules).
+    from tools.jaxlint.rules_concurrency import CONCURRENCY_RULES
     from tools.jaxlint.rules_perf import PERF_RULES
     from tools.jaxlint.rules_protocol import PROTOCOL_RULES
 
-    return CORE_RULES + PERF_RULES + PROTOCOL_RULES
+    return CORE_RULES + PERF_RULES + PROTOCOL_RULES + CONCURRENCY_RULES
 
 
 ALL_RULES: List[Rule] = _all_rules()

@@ -1,0 +1,881 @@
+"""The jaxlint concurrency pack: JL017-JL020, protocol-race invariants.
+
+The coordination protocols (lease work queue, set-once KV claims, fleet
+flips, store claim/lease/GC) are about to go cross-host (ROADMAP items
+5/6), which multiplies interleavings and failure windows. These rules
+catch the canonical distributed-systems bugs statically, before the
+network arrives — each one is a race `tools/schedcheck` can reproduce
+dynamically, but a review-time diagnosis is cheaper than a schedule
+exploration:
+
+- JL017: a KV write that is neither a set-once claim
+  (`set(..., overwrite=False)`) nor reached exclusively through a
+  claim/ownership guard is a lost-update race — two writers, last one
+  silently wins.
+- JL018: an attribute written both from a `threading.Thread(target=...)`
+  path and from the main path with no common lock is a data race; the
+  interleaving that loses one write exists even under the GIL.
+- JL019: exists-then-open / listdir-then-open in the coordination and
+  persistence dirs is a TOCTOU window — the canonical fixes are the
+  staged+fsync+rename and `os.link` claim idioms of
+  `store/blobstore.py`, or opening and handling `FileNotFoundError`.
+- JL020: deadline/TTL arithmetic that mixes `time.time`,
+  `time.monotonic`, and injected-`clock` domains compares timestamps
+  from different epochs; and a function that takes a deadline but calls
+  a bounded helper without forwarding one silently unbounds the wait.
+
+All interprocedural over `tools.jaxlint.callgraph`: guards on CALLER
+paths count (JL017), thread roles are reachability from spawn sites
+(JL018), and findings carry the full call chain.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.jaxlint.callgraph import dotted_name, module_walk
+from tools.jaxlint.engine import Finding, ProjectContext
+from tools.jaxlint.rules import Rule, _scope_walk, _short_name
+
+#: Lock factory names shared by JL018's common-lock analysis (the same
+#: set JL014 keys its lock identities on).
+_LOCK_FACTORIES = {
+    "Lock",
+    "RLock",
+    "Condition",
+    "Semaphore",
+    "BoundedSemaphore",
+}
+
+
+def _entry_chain(callers, qualname: str) -> List[str]:
+    """[entry, ..., qualname]: deterministic caller chain to a root."""
+    chain = [qualname]
+    seen = {qualname}
+    cur = qualname
+    while True:
+        ups = sorted(c for c in callers.get(cur, ()) if c not in seen)
+        if not ups:
+            return chain
+        cur = ups[0]
+        seen.add(cur)
+        chain.insert(0, cur)
+
+
+def _protected_nodes(func: ast.AST) -> Set[int]:
+    """ids of nodes inside a try-body whose handlers catch OS errors.
+
+    An operation that races a concurrent unlink/rename is SAFE when the
+    loss is handled where it surfaces — `open` inside
+    `try: ... except FileNotFoundError` is the race-free idiom, not a
+    TOCTOU.
+    """
+    catching = {
+        "OSError",
+        "IOError",
+        "EnvironmentError",
+        "FileNotFoundError",
+        "FileExistsError",
+        "PermissionError",
+        "Exception",
+        "BaseException",
+    }
+    protected: Set[int] = set()
+    for node in _scope_walk(func):
+        if not isinstance(node, ast.Try):
+            continue
+        handles = False
+        for handler in node.handlers:
+            if handler.type is None:
+                handles = True
+                break
+            types = (
+                handler.type.elts
+                if isinstance(handler.type, ast.Tuple)
+                else [handler.type]
+            )
+            for t in types:
+                name = dotted_name(t) or ""
+                if name.split(".")[-1] in catching:
+                    handles = True
+        if not handles:
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                protected.add(id(sub))
+    return protected
+
+
+# ---------------------------------------------------------------- JL017
+
+
+class RawOverwriteRule(Rule):
+    """KV coordination writes outside the set-once/ownership idioms.
+
+    In the coordination modules every KV key is either a set-once claim
+    (`set(..., overwrite=False)` — the insert-if-absent primitive all
+    three stores implement atomically), a single-writer record whose
+    key embeds the writer's own identity (heartbeats), or a value whose
+    every write path first proves ownership (a lease/token field check,
+    or winning a set-once claim in the same function). A plain
+    `kv.set(key, value)` reached from any caller path with none of
+    those guards is a lost-update race: two concurrent writers each
+    believe their value landed, and the loser's update silently
+    vanishes — exactly the failure mode `schedcheck`'s
+    `ref.put_overwrite` and `wq.skip_claim_token` mutants demonstrate
+    dynamically.
+    """
+
+    rule_id = "JL017"
+    summary = "raw overwrite of a coordination key (lost-update race)"
+    project = True
+
+    _SCOPED_DIRS = ("/distributed/", "/serving/", "/experimental/")
+
+    #: Identity tokens: a key expression mentioning the writer's own id
+    #: is a single-writer key (heartbeat records), not a shared cell.
+    _IDENTITY = {"worker", "owner", "holder"}
+
+    #: Lease/token fields whose comparison marks an ownership check.
+    _OWNER_FIELDS = {
+        "owner",
+        "replica",
+        "attempt",
+        "worker",
+        "holder",
+        "lease_id",
+    }
+
+    _KV_RE = re.compile(r"(^|_)kv$")
+
+    def _in_scope(self, path: str) -> bool:
+        slashed = "/" + path.replace("\\", "/")
+        return any(d in slashed for d in self._SCOPED_DIRS)
+
+    def _kv_set_call(self, node: ast.Call) -> bool:
+        name = dotted_name(node.func) or ""
+        parts = name.split(".")
+        if len(parts) < 2 or parts[-1] != "set":
+            return False
+        return bool(self._KV_RE.search(parts[-2]))
+
+    @staticmethod
+    def _overwrite_false(node: ast.Call) -> Optional[bool]:
+        """True/False for a constant `overwrite=` kwarg, None if absent
+        or non-constant (treated as the overwriting default)."""
+        for kw in node.keywords:
+            if kw.arg == "overwrite" and isinstance(kw.value, ast.Constant):
+                return kw.value.value is False
+        return None
+
+    def _single_writer_key(self, node: ast.Call) -> bool:
+        if not node.args:
+            return False
+        for sub in ast.walk(node.args[0]):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is None:
+                continue
+            if name in self._IDENTITY or name.endswith("_id"):
+                return True
+        return False
+
+    def _is_guard(self, func: ast.AST) -> bool:
+        """A claim (`set(..., overwrite=False)` / `os.link`) or an
+        ownership check (comparing a lease/token identity field)."""
+        for node in _scope_walk(func):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if name == "os.link":
+                    return True
+                if name.split(".")[-1] == "set":
+                    if self._overwrite_false(node):
+                        return True
+            elif isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Subscript)
+                        and isinstance(sub.slice, ast.Constant)
+                        and sub.slice.value in self._OWNER_FIELDS
+                    ):
+                        return True
+                    if (
+                        isinstance(sub, ast.Call)
+                        and (dotted_name(sub.func) or "").split(".")[-1]
+                        == "get"
+                        and sub.args
+                        and isinstance(sub.args[0], ast.Constant)
+                        and sub.args[0].value in self._OWNER_FIELDS
+                    ):
+                        return True
+        return False
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+
+        scoped = [p for p in sorted(proj.files) if self._in_scope(p)]
+        if not scoped:
+            return []
+        graph = proj.graph
+        guards = {
+            qual
+            for qual in graph.functions
+            if self._is_guard(graph.functions[qual].node)
+        }
+        # Exposure: BFS from unguarded entries that never passes THROUGH
+        # a guard — a write only reachable via guarded callers is safe.
+        callers = dataflow.callers_of(graph.call_edges)
+        filtered = {
+            qual: (set() if qual in guards else graph.call_edges.get(qual, set()))
+            for qual in graph.functions
+        }
+        roots = sorted(
+            qual
+            for qual in graph.functions
+            if qual not in guards and not callers.get(qual)
+        )
+        exposed = dataflow.reach_with_chains(filtered, roots)
+
+        findings: List[Finding] = []
+        for path in scoped:
+            ctx = proj.files[path]
+            for info in graph.functions_in(path):
+                qual = info.qualname
+                if qual in guards or qual not in exposed:
+                    continue
+                chain = exposed[qual]
+                via = (
+                    " [reached via %s]"
+                    % dataflow.render_chain(graph, chain)
+                    if len(chain) > 1
+                    else ""
+                )
+                for node in _scope_walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not self._kv_set_call(node):
+                        continue
+                    if self._overwrite_false(node):
+                        continue
+                    if self._single_writer_key(node):
+                        continue
+                    findings.append(
+                        ctx.finding(
+                            node,
+                            self.rule_id,
+                            "raw overwrite of a coordination key in %r "
+                            "— a concurrent writer's value is silently "
+                            "lost; claim it set-once "
+                            "(overwrite=False), key it by the writer's "
+                            "own id, or put an ownership check on "
+                            "every caller path%s" % (info.name, via),
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------- JL018
+
+
+class CrossThreadStateRule(Rule):
+    """Shared attributes written from two thread roles with no lock.
+
+    Thread roles are inferred from spawn sites: every function
+    reachable (calls or traced references) from a
+    `threading.Thread(target=...)` / `threading.Timer(...)` target runs
+    on a background thread — the lease renewers, heartbeat loops, and
+    frontend workers. An instance attribute assigned both from a
+    background-role method and from a main-role method needs a common
+    lock covering both writes (held lexically or by any caller — the
+    acquired-locks closure); with none, the interleaving that loses one
+    write exists. Construction is exempt (`__init__` runs before the
+    thread starts, a happens-before edge), and reads are not flagged —
+    the repo's single-writer publish pattern (`LeaseRenewer.lost`) is
+    legal under the GIL.
+    """
+
+    rule_id = "JL018"
+    summary = "cross-thread attribute write with no common lock"
+    project = True
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+
+        graph = proj.graph
+        spawn_roots: Dict[str, str] = {}  # target qual -> spawning func
+        for qual in sorted(graph.functions):
+            info = graph.functions[qual]
+            mod = graph.modules[info.path]
+            for node in _scope_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = (dotted_name(node.func) or "").split(".")[-1]
+                if callee not in ("Thread", "Timer"):
+                    continue
+                target = None
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = dotted_name(kw.value)
+                if callee == "Timer" and target is None and len(node.args) >= 2:
+                    target = dotted_name(node.args[1])
+                if not target:
+                    continue
+                resolved = graph.resolve(target, mod, info)
+                if resolved is not None:
+                    spawn_roots.setdefault(resolved, qual)
+        if not spawn_roots:
+            return []
+        bg_chains = dataflow.reach_with_chains(
+            graph.edges, sorted(spawn_roots)
+        )
+
+        # The acquired-locks closure: locks a function's CALLERS hold
+        # anywhere transfer to it (a write in a helper called under the
+        # pool lock is covered).
+        class_locks = self._class_locks(proj)
+        direct_locks: Dict[str, Set[str]] = {}
+        for qual in graph.functions:
+            info = graph.functions[qual]
+            direct_locks[qual] = self._locks_acquired(info, class_locks)
+        rev = dataflow.callers_of(graph.call_edges)
+        rev_edges = {qual: set(rev.get(qual, ())) for qual in graph.functions}
+        caller_locks = dataflow.closure_facts(rev_edges, direct_locks)
+
+        # attr writes grouped by (path, class, attr) and role.
+        sites: Dict[Tuple[str, str, str], Dict[str, List]] = {}
+        for qual in sorted(graph.functions):
+            info = graph.functions[qual]
+            if info.class_name is None or info.name == "__init__":
+                continue
+            role = "bg" if qual in bg_chains else "main"
+            writes: List[Tuple[str, ast.AST, Set[str]]] = []
+            self._collect_writes(
+                info.node,
+                [],
+                class_locks.get((info.path, info.class_name), set()),
+                info,
+                writes,
+            )
+            for attr, node, held in writes:
+                key = (info.path, info.class_name, attr)
+                effective = set(held) | caller_locks.get(qual, set())
+                sites.setdefault(key, {}).setdefault(role, []).append(
+                    (node.lineno, node, qual, effective)
+                )
+
+        findings: List[Finding] = []
+        for key in sorted(sites):
+            path, class_name, attr = key
+            by_role = sites[key]
+            if "bg" not in by_role or "main" not in by_role:
+                continue
+            hit = None
+            for bg_line, bg_node, bg_qual, bg_locks in sorted(
+                by_role["bg"], key=lambda s: s[0]
+            ):
+                for main_line, _mn, main_qual, main_locks in sorted(
+                    by_role["main"], key=lambda s: s[0]
+                ):
+                    if not (bg_locks & main_locks):
+                        hit = (bg_node, bg_qual, main_qual, main_line)
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            bg_node, bg_qual, main_qual, main_line = hit
+            chain = bg_chains[bg_qual]
+            spawner = spawn_roots.get(chain[0], "")
+            via = dataflow.render_chain(graph, chain)
+            findings.append(
+                proj.files[path].finding(
+                    bg_node,
+                    self.rule_id,
+                    "attribute %r of %s is written on the background "
+                    "thread here AND from the main path (%s, line %d) "
+                    "with no common lock — the interleaving that "
+                    "loses one write exists; guard both writes with "
+                    "one lock [thread root spawned in %s; chain: %s]"
+                    % (
+                        attr,
+                        class_name,
+                        _short_name(main_qual),
+                        main_line,
+                        _short_name(spawner),
+                        via,
+                    ),
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _class_locks(proj) -> Dict[Tuple[str, str], Set[str]]:
+        """(path, class name) -> attrs assigned a threading factory."""
+        out: Dict[Tuple[str, str], Set[str]] = {}
+        for path in sorted(proj.files):
+            for node in module_walk(proj.files[path].tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                attrs = out.setdefault((path, node.name), set())
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign) or not isinstance(
+                        sub.value, ast.Call
+                    ):
+                        continue
+                    factory = (
+                        dotted_name(sub.value.func) or ""
+                    ).split(".")[-1]
+                    if factory not in _LOCK_FACTORIES:
+                        continue
+                    for tgt in sub.targets:
+                        tname = dotted_name(tgt) or ""
+                        if tname.startswith("self.") and tname.count(".") == 1:
+                            attrs.add(tname.split(".", 1)[1])
+        return out
+
+    def _locks_acquired(self, info, class_locks) -> Set[str]:
+        lock_attrs = class_locks.get((info.path, info.class_name), set())
+        acquired: Set[str] = set()
+        for node in _scope_walk(info.node):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                lock = self._lock_id(item.context_expr, info, lock_attrs)
+                if lock:
+                    acquired.add(lock)
+        return acquired
+
+    @staticmethod
+    def _lock_id(expr, info, lock_attrs) -> Optional[str]:
+        name = dotted_name(expr) or ""
+        if name.startswith("self.") and name.split(".", 1)[1] in lock_attrs:
+            return "%s::%s.%s" % (
+                info.path,
+                info.class_name,
+                name.split(".", 1)[1],
+            )
+        return None
+
+    def _collect_writes(self, node, held, lock_attrs, info, out) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.With):
+                acquired = [
+                    lock
+                    for item in child.items
+                    for lock in [
+                        self._lock_id(item.context_expr, info, lock_attrs)
+                    ]
+                    if lock
+                ]
+                self._collect_writes(
+                    child, held + acquired, lock_attrs, info, out
+                )
+                continue
+            targets: List[ast.AST] = []
+            if isinstance(child, ast.Assign):
+                targets = list(child.targets)
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                targets = [child.target]
+            for tgt in targets:
+                attr = self._self_attr(tgt)
+                if attr is not None and attr not in lock_attrs:
+                    out.append((attr, child, set(held)))
+            self._collect_writes(child, held, lock_attrs, info, out)
+
+    @staticmethod
+    def _self_attr(tgt: ast.AST) -> Optional[str]:
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            return tgt.attr
+        return None
+
+
+# ---------------------------------------------------------------- JL019
+
+
+class ToctouRule(Rule):
+    """Check-then-use filesystem races in coordination/persistence dirs.
+
+    `os.path.exists(p)` followed by `open(p)` (or a rename/unlink of
+    `p`), and `os.listdir(d)` followed by `open()` of an entry, are
+    TOCTOU windows: a concurrent GC sweep, quarantine rename, or
+    set-once claim can invalidate the check before the use. The
+    race-free idioms — canonical in `store/blobstore.py` — are to
+    perform the operation and handle `FileNotFoundError`/`OSError`
+    where it surfaces, or to claim via `os.link`/staged-rename. An
+    operation inside a try whose handlers catch OS errors is therefore
+    exempt.
+    """
+
+    rule_id = "JL019"
+    summary = "filesystem TOCTOU (check-then-use without error handling)"
+    project = True
+
+    _SCOPED_DIRS = ("/store/", "/distributed/", "/serving/")
+    _SCOPED_SUFFIXES = ("/core/checkpoint.py", "/robustness/watchdog.py")
+
+    _CHECKS = {"os.path.exists", "os.path.isfile"}
+    _USES = {
+        "os.replace",
+        "os.rename",
+        "os.unlink",
+        "os.remove",
+        "os.link",
+        "os.path.getmtime",
+        "os.stat",
+        "os.utime",
+    }
+
+    def _in_scope(self, path: str) -> bool:
+        slashed = "/" + path.replace("\\", "/")
+        return slashed.endswith(self._SCOPED_SUFFIXES) or any(
+            d in slashed for d in self._SCOPED_DIRS
+        )
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        from tools.jaxlint import dataflow
+
+        scoped = [p for p in sorted(proj.files) if self._in_scope(p)]
+        if not scoped:
+            return []
+        graph = proj.graph
+        callers = dataflow.callers_of(graph.call_edges)
+        findings: List[Finding] = []
+        for path in scoped:
+            ctx = proj.files[path]
+            for info in graph.functions_in(path):
+                chain = _entry_chain(callers, info.qualname)
+                via = (
+                    " [reached via %s]"
+                    % dataflow.render_chain(graph, chain)
+                    if len(chain) > 1
+                    else ""
+                )
+                findings.extend(
+                    self._check_function(ctx, info.node, via)
+                )
+        return findings
+
+    def _check_function(self, ctx, func, via) -> List[Finding]:
+        protected = _protected_nodes(func)
+        checked: Dict[str, int] = {}  # ast.dump(expr) -> check lineno
+        tainted = self._tainted_names(func)
+        findings: List[Finding] = []
+        # First pass: record every check site. Traversal order is not
+        # textual order, so checks must all be known before uses are
+        # judged — the `lineno >` guard below restores the textual
+        # check-before-use requirement.
+        for node in _scope_walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name in self._CHECKS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    key = ast.dump(arg)
+                    checked[key] = min(
+                        node.lineno, checked.get(key, node.lineno)
+                    )
+        for node in _scope_walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name in self._CHECKS:
+                continue
+            is_open = name == "open" or name.endswith(".open")
+            is_use = name in self._USES
+            if not (is_open or is_use) or id(node) in protected:
+                continue
+            hit = None
+            for arg in node.args:
+                if (
+                    isinstance(arg, (ast.Name, ast.Attribute))
+                    and ast.dump(arg) in checked
+                    and node.lineno > checked[ast.dump(arg)]
+                ):
+                    hit = "exists"
+                    break
+                if is_open and any(
+                    isinstance(sub, ast.Name) and sub.id in tainted
+                    for sub in ast.walk(arg)
+                ):
+                    hit = "listdir"
+                    break
+            if hit is None:
+                continue
+            what = name if is_use else "open(...)"
+            if hit == "exists":
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "%s races the os.path.exists() check above it "
+                        "(TOCTOU): a concurrent unlink/rename/claim "
+                        "can land between check and use — do the "
+                        "operation and handle FileNotFoundError/"
+                        "OSError instead%s" % (what, via),
+                    )
+                )
+            else:
+                findings.append(
+                    ctx.finding(
+                        node,
+                        self.rule_id,
+                        "%s of an os.listdir() entry races the "
+                        "listing (TOCTOU): entries can vanish between "
+                        "list and open (GC sweep, quarantine rename) "
+                        "— handle FileNotFoundError/OSError at the "
+                        "open%s" % (what, via),
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _tainted_names(func) -> Set[str]:
+        """Loop variables over os.listdir results, plus one-hop derived
+        names (`path = os.path.join(d, name)`)."""
+        listdir_vars: Set[str] = set()
+        for node in _scope_walk(func):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Call,)
+            ):
+                calls = [
+                    dotted_name(c.func) or ""
+                    for c in ast.walk(node.value)
+                    if isinstance(c, ast.Call)
+                ]
+                if "os.listdir" in calls:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            listdir_vars.add(tgt.id)
+        tainted: Set[str] = set()
+        for node in _scope_walk(func):
+            if not isinstance(node, ast.For):
+                continue
+            iter_names = {
+                sub.id
+                for sub in ast.walk(node.iter)
+                if isinstance(sub, ast.Name)
+            }
+            direct_listdir = any(
+                isinstance(c, ast.Call)
+                and (dotted_name(c.func) or "") == "os.listdir"
+                for c in ast.walk(node.iter)
+            )
+            if (iter_names & listdir_vars) or direct_listdir:
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        tainted.add(sub.id)
+        # One propagation pass: path = os.path.join(dir, name).
+        for _ in range(2):
+            for node in _scope_walk(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if any(
+                    isinstance(sub, ast.Name) and sub.id in tainted
+                    for sub in ast.walk(node.value)
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted.add(tgt.id)
+        return tainted
+
+
+# ---------------------------------------------------------------- JL020
+
+
+class ClockDomainRule(Rule):
+    """Deadline arithmetic across clock domains, and dropped deadlines.
+
+    Three clock domains coexist: `time.time` (wall — shared across
+    processes, steppable by NTP), `time.monotonic`/`perf_counter`
+    (process-local, never steps), and the injected `clock()` seam
+    (mock-steppable in tests, wall in production). A deadline computed
+    in one domain and compared in another is wrong by an arbitrary
+    offset — under a mocked clock the comparison never fires, which is
+    exactly the hang schedcheck's clock actor would need to explore
+    forever to find. Separately: a function that accepts a deadline
+    (`timeout_secs`/`deadline`) and calls a bounded helper WITHOUT
+    forwarding any deadline silently replaces the caller's budget with
+    the helper's default — the frame-header deadline-propagation
+    discipline ROADMAP item 5 requires, checked statically.
+    """
+
+    rule_id = "JL020"
+    summary = "clock-domain mixing or dropped deadline"
+    project = True
+
+    _DEADLINE_PARAMS = ("timeout_secs", "timeout", "deadline", "deadline_secs")
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        graph = proj.graph
+        findings: List[Finding] = []
+        for path in sorted(proj.files):
+            ctx = proj.files[path]
+            for info in graph.functions_in(path):
+                findings.extend(self._check_domains(ctx, info.node))
+                findings.extend(
+                    self._check_forwarding(ctx, info, graph)
+                )
+        return findings
+
+    # ------------------------------------------------- domain mixing
+
+    @staticmethod
+    def _call_domain(name: str) -> Optional[str]:
+        if name == "time.time":
+            return "time.time"
+        if name in ("time.monotonic", "time.perf_counter", "monotonic"):
+            return "time.monotonic"
+        if name.split(".")[-1] in ("clock", "_clock"):
+            return "injected clock()"
+        return None
+
+    def _expr_domains(self, expr, var_domains) -> Set[str]:
+        domains: Set[str] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                d = self._call_domain(dotted_name(node.func) or "")
+                if d:
+                    domains.add(d)
+            elif isinstance(node, ast.Name) and node.id in var_domains:
+                domains.add(var_domains[node.id])
+        return domains
+
+    def _check_domains(self, ctx, func) -> List[Finding]:
+        var_domains: Dict[str, str] = {}
+        for _ in range(2):  # straight-line fixpoint
+            for node in _scope_walk(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                ds = self._expr_domains(node.value, var_domains)
+                if len(ds) == 1:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            var_domains[tgt.id] = next(iter(ds))
+        findings: List[Finding] = []
+        self._flag_mixed(ctx, func, var_domains, findings)
+        return findings
+
+    def _flag_mixed(self, ctx, node, var_domains, out) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.Compare) or (
+                isinstance(child, ast.BinOp)
+                and isinstance(child.op, (ast.Add, ast.Sub))
+            ):
+                ds = self._expr_domains(child, var_domains)
+                if len(ds) >= 2:
+                    out.append(
+                        ctx.finding(
+                            child,
+                            self.rule_id,
+                            "deadline arithmetic mixes clock domains "
+                            "(%s): timestamps from different epochs "
+                            "differ by an arbitrary offset — compute "
+                            "and compare the deadline in ONE domain"
+                            % " vs ".join(sorted(ds)),
+                        )
+                    )
+                    continue  # outermost expression wins
+            self._flag_mixed(ctx, child, var_domains, out)
+
+    # --------------------------------------------- deadline forwarding
+
+    @classmethod
+    def _deadline_params(cls, func) -> List[str]:
+        args = func.args
+        names = [
+            a.arg
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        ]
+        return [n for n in names if n in cls._DEADLINE_PARAMS]
+
+    def _check_forwarding(self, ctx, info, graph) -> List[Finding]:
+        func = info.node
+        if isinstance(func, ast.Lambda):
+            return []
+        own = self._deadline_params(func)
+        if not own:
+            return []
+        mod = graph.modules[info.path]
+        findings: List[Finding] = []
+        for node in _scope_walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args) or any(
+                kw.arg is None for kw in node.keywords
+            ):
+                continue
+            target = dotted_name(node.func)
+            resolved = graph.resolve(target, mod, info) if target else None
+            if resolved is None:
+                continue
+            callee = graph.functions[resolved]
+            if isinstance(callee.node, ast.Lambda):
+                continue
+            callee_params = [
+                a.arg
+                for a in (
+                    list(callee.node.args.posonlyargs)
+                    + list(callee.node.args.args)
+                )
+                if a.arg not in ("self", "cls")
+            ]
+            callee_deadlines = self._deadline_params(callee.node)
+            if not callee_deadlines:
+                continue
+            if any(kw.arg in self._DEADLINE_PARAMS for kw in node.keywords):
+                continue
+            first = callee_deadlines[0]
+            if first in callee_params and len(node.args) > callee_params.index(
+                first
+            ):
+                continue  # covered positionally
+            findings.append(
+                ctx.finding(
+                    node,
+                    self.rule_id,
+                    "%r takes %r but this call to %r forwards no "
+                    "deadline — the wait silently falls back to the "
+                    "callee's default budget instead of the caller's "
+                    "[call chain: %s -> %s]"
+                    % (
+                        info.name,
+                        own[0],
+                        _short_name(resolved),
+                        _short_name(info.qualname),
+                        _short_name(resolved),
+                    ),
+                )
+            )
+        return findings
+
+
+CONCURRENCY_RULES: List[Rule] = [
+    RawOverwriteRule(),
+    CrossThreadStateRule(),
+    ToctouRule(),
+    ClockDomainRule(),
+]

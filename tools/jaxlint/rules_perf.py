@@ -17,7 +17,7 @@ import ast
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from tools.jaxlint.callgraph import dotted_name
+from tools.jaxlint.callgraph import dotted_name, module_walk
 from tools.jaxlint.engine import FileContext, Finding, ProjectContext
 from tools.jaxlint.rules import (
     Rule,
@@ -119,7 +119,7 @@ class DtypePromotionRule(Rule):
 
     @staticmethod
     def _uses_bf16(tree: ast.Module) -> bool:
-        for node in ast.walk(tree):
+        for node in module_walk(tree):
             if isinstance(node, ast.Attribute) and node.attr == "bfloat16":
                 return True
             if isinstance(node, ast.Name) and node.id == "bfloat16":
@@ -229,7 +229,7 @@ class LoopInvariantScanRule(Rule):
             mod = graph.modules.get(path)
             if mod is None:
                 continue
-            for node in ast.walk(ctx.tree):
+            for node in module_walk(ctx.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func) or ""

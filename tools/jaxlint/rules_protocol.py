@@ -17,7 +17,7 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from tools.jaxlint.callgraph import dotted_name
+from tools.jaxlint.callgraph import dotted_name, module_walk
 from tools.jaxlint.engine import FileContext, Finding, ProjectContext
 from tools.jaxlint.rules import Rule, _scope_walk, _short_name
 
@@ -289,7 +289,7 @@ class LockOrderRule(Rule):
         kinds: Dict[str, str] = {}
         for path in sorted(proj.files):
             ctx = proj.files[path]
-            for node in ast.walk(ctx.tree):
+            for node in module_walk(ctx.tree):
                 if not isinstance(node, ast.Assign) or not isinstance(
                     node.value, ast.Call
                 ):
@@ -514,7 +514,7 @@ class FaultSiteCoverageRule(Rule):
         # review time instead.
         for path in sorted(proj.files):
             file_ctx = proj.files[path]
-            for node in ast.walk(file_ctx.tree):
+            for node in module_walk(file_ctx.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func) or ""
@@ -547,7 +547,7 @@ class FaultSiteCoverageRule(Rule):
             ):
                 continue
             ctx = proj.files[path]
-            for node in ast.walk(ctx.tree):
+            for node in module_walk(ctx.tree):
                 if (
                     isinstance(node, ast.Assign)
                     and any(
@@ -568,7 +568,7 @@ class FaultSiteCoverageRule(Rule):
     def _tripped_sites(self, proj: ProjectContext) -> Set[str]:
         tripped: Set[str] = set()
         for path in sorted(proj.files):
-            for node in ast.walk(proj.files[path].tree):
+            for node in module_walk(proj.files[path].tree):
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func) or ""

@@ -1,0 +1,50 @@
+"""schedcheck: deterministic interleaving exploration for the
+coordination protocols.
+
+The repo's hand-rolled protocols — the lease work queue, set-once KV
+claims, the fleet flip coordinator, store claim/lease/GC arbitration —
+are exactly the code ROADMAP items 5 and 6 push cross-host, where every
+race window widens. schedcheck drives the *real* protocol objects
+(no models-of-the-code) through exhaustively enumerated thread
+interleavings and crash points, and asserts the protocol invariants:
+exactly one flip outcome, no double execution of a work unit at one
+attempt, done-implies-payload, no evict of a lease-pinned blob,
+crash-anywhere recoverability.
+
+Three pieces:
+
+- `explorer`: the scheduler. Protocol code announces its critical
+  windows via `adanet_tpu.robustness.sched.sched_point(label)` (the
+  same injection style as the mocked clocks); the explorer parks actor
+  threads there and enumerates every order of release, re-executing
+  the system from scratch per schedule (stateless DFS over choice
+  traces). Crashes are injected at yield points.
+- `models`: the registry binding each protocol model to its live code
+  seams and its mutants — the JL015 discipline applied to schedules:
+  `tests/test_schedcheck.py` cross-checks every registered seam label
+  against the named sources, so no protocol silently drops out.
+- `mutants`: seeded known-bad protocol variants (drop the set-once
+  claim, renew after expiry, reorder done-before-payload, ...). The
+  explorer must find a violating schedule for every mutant — proof the
+  checker has teeth, not just green runs.
+
+Run from the CLI: `python -m tools.schedcheck [--model NAME] [--mutant ID]`.
+"""
+
+from tools.schedcheck.explorer import (
+    ActorCrash,
+    ExplorationError,
+    Explorer,
+    Report,
+)
+from tools.schedcheck.models import MODELS
+from tools.schedcheck.mutants import MUTANTS
+
+__all__ = [
+    "ActorCrash",
+    "ExplorationError",
+    "Explorer",
+    "MODELS",
+    "MUTANTS",
+    "Report",
+]

@@ -1,0 +1,142 @@
+"""CLI: run schedcheck explorations from the shell.
+
+    python -m tools.schedcheck                  # all models + all mutants
+    python -m tools.schedcheck --model wq       # one model, unmutated
+    python -m tools.schedcheck --mutant wq.skip_claim_token
+    python -m tools.schedcheck --list
+
+Exit status is 0 only when every unmutated model passes AND every
+requested mutant is killed — the same contract tests/test_schedcheck.py
+enforces in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from tools.schedcheck.explorer import Explorer, Report
+from tools.schedcheck.models import MODELS
+from tools.schedcheck.mutants import MUTANTS
+
+
+def _explore(
+    model,
+    mutant_id=None,
+    max_schedules=None,
+    max_depth=80,
+    stop_on_first=True,
+) -> Report:
+    restore = None
+    if mutant_id is not None:
+        restore = MUTANTS[mutant_id].apply()
+    try:
+        explorer = Explorer(
+            model.build,
+            max_schedules=max_schedules or model.max_schedules,
+            max_depth=max_depth,
+            max_crashes=model.max_crashes,
+            stop_on_first=stop_on_first,
+            model_name=model.name,
+            mutant_name=mutant_id,
+        )
+        return explorer.explore()
+    finally:
+        if restore is not None:
+            restore()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m tools.schedcheck")
+    parser.add_argument("--model", choices=sorted(MODELS))
+    parser.add_argument("--mutant", choices=sorted(MUTANTS))
+    parser.add_argument("--list", action="store_true")
+    parser.add_argument("--max-schedules", type=int, default=None)
+    parser.add_argument("--max-depth", type=int, default=80)
+    parser.add_argument(
+        "--json", action="store_true", help="dump full reports"
+    )
+    args = parser.parse_args(argv)
+
+    # The protocols under test log their own decisions (flip
+    # aborts/commits on every explored schedule); keep exploration
+    # output to the explorer's deterministic report lines.
+    logging.getLogger("adanet_tpu").setLevel(logging.ERROR)
+
+    if args.list:
+        for name in sorted(MODELS):
+            model = MODELS[name]
+            print("model  %-10s %s" % (name, model.description))
+            for mid in model.mutants:
+                print("mutant %-28s %s" % (mid, MUTANTS[mid].description))
+        return 0
+
+    failures = []
+    runs = []  # (kind, report)
+    if args.mutant:
+        mutants = [args.mutant]
+        models = []
+    elif args.model:
+        mutants = []
+        models = [args.model]
+    else:
+        models = sorted(MODELS)
+        mutants = sorted(MUTANTS)
+
+    for name in models:
+        report = _explore(
+            MODELS[name],
+            max_schedules=args.max_schedules,
+            max_depth=args.max_depth,
+        )
+        runs.append(("unmutated", report))
+        status = "ok" if report.ok else "VIOLATION"
+        if not report.ok:
+            failures.append(
+                "unmutated model %r found a violation: %s"
+                % (name, report.violations[0].message)
+            )
+        print(
+            "model  %-10s %-9s %5d schedules (max depth %d%s)"
+            % (
+                name,
+                status,
+                report.schedules,
+                report.max_trace_len,
+                "" if report.exhausted else ", capped",
+            )
+        )
+
+    for mid in mutants:
+        model = MODELS[MUTANTS[mid].model]
+        report = _explore(
+            model,
+            mutant_id=mid,
+            max_schedules=args.max_schedules,
+            max_depth=args.max_depth,
+        )
+        runs.append(("mutant", report))
+        killed = not report.ok
+        if not killed:
+            failures.append(
+                "mutant %r SURVIVED %d schedules — the checker has no "
+                "teeth for it" % (mid, report.schedules)
+            )
+        print(
+            "mutant %-28s %-8s after %d schedules"
+            % (mid, "killed" if killed else "SURVIVED", report.schedules)
+        )
+        if killed and not args.json:
+            print("       kill: %s" % report.violations[0].message.split("\n")[0])
+
+    if args.json:
+        for _kind, report in runs:
+            print(report.dumps())
+    for message in failures:
+        print("FAIL: %s" % message, file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,413 @@
+"""The schedcheck scheduler: exhaustive, deterministic interleavings.
+
+## How a schedule runs
+
+A *system* is a set of named actors (plain callables) built fresh by a
+model factory per execution. Each actor runs on a real thread, but only
+ever when granted: the thread parks on a per-actor semaphore at start
+and at every `sched_point(label)` the protocol code announces (the
+yield seams threaded through `distributed/scheduler.py`,
+`serving/fleet/flip_coordinator.py`, `store/{blobstore,leases,gc}.py`).
+Between two grants an actor executes atomically — the interleaving
+granularity IS the seam placement, which is why seams sit exactly at
+the protocol race windows (token-won-before-lease-write, mark-done-
+before-sweep, staged-before-link-claim).
+
+At each step the controller picks one enabled actor and releases it
+until its next yield, its completion, or its failure. The sequence of
+picks is the *choice trace*. Exploration is stateless DFS over traces:
+execute with a forced prefix, extend greedily (first enabled choice),
+record every untried alternative past the prefix as a new prefix, and
+re-execute from scratch. Same prefix => same protocol state => same
+enabled set, which requires models to be deterministic: injected
+clocks, no wall-time-dependent control flow, no randomness that feeds
+back into scheduling decisions.
+
+## Crashes
+
+A crash choice at a yield point makes `sched_point` raise `ActorCrash`
+(a BaseException, so protocol `except Exception` handlers cannot
+swallow it) in the parked thread. This approximates SIGKILL at the
+seam: the actor performs no further protocol steps, but — unlike a real
+SIGKILL — `finally:` blocks on the unwind path do run (e.g. a staged
+temp file may be unlinked that a real crash would leave for GC's stray
+sweep). That approximation is conservative for the invariants checked
+here and is documented in docs/schedcheck.md.
+
+## Determinism
+
+Enabled actors are sorted by name, step choices precede crash choices,
+and the DFS stack is LIFO over that ordering — two runs of the same
+exploration produce byte-identical reports (`Report.to_json` sorts
+keys and contains no wall-clock values).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from adanet_tpu.robustness import sched
+
+#: Wall-clock guard for a single grant; only trips when an actor blocks
+#: outside the seam discipline (a real deadlock or an unseamed wait).
+_GRANT_TIMEOUT_SECS = 30.0
+
+
+class ActorCrash(BaseException):
+    """Raised inside an actor thread to simulate a crash at a seam.
+
+    BaseException deliberately: protocol-level `except Exception`
+    recovery must not swallow a simulated SIGKILL.
+    """
+
+
+class ExplorationError(RuntimeError):
+    """The exploration itself broke (hung actor, replay divergence)."""
+
+
+@dataclasses.dataclass
+class Violation:
+    """One invariant failure, with the schedule that produced it."""
+
+    model: str
+    mutant: Optional[str]
+    message: str
+    trace: List[str]
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Report:
+    """Outcome of one exploration (deterministic: no timestamps)."""
+
+    model: str
+    mutant: Optional[str]
+    schedules: int
+    truncated_schedules: int
+    max_trace_len: int
+    violations: List[Violation]
+    exhausted: bool  #: False when max_schedules stopped the DFS early.
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["ok"] = self.ok
+        return out
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True, indent=2)
+
+
+class _Actor:
+    def __init__(self, name: str, fn: Callable[[], None]):
+        self.name = name
+        self.fn = fn
+        self.go = threading.Semaphore(0)
+        self.state = "ready"  # ready|yielded|finished|crashed|failed
+        self.label: Optional[str] = None  # current seam, when yielded
+        self.crash_pending = False
+        self.error: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class _Execution:
+    """One run of the system under one (possibly partial) schedule."""
+
+    def __init__(self, actors: Dict[str, Callable[[], None]]):
+        self._actors = {name: _Actor(name, fn) for name, fn in actors.items()}
+        self._by_ident: Dict[int, _Actor] = {}
+        self._ready = threading.Semaphore(0)
+
+    # ----------------------------------------------------------- hook
+
+    def _hook(self, label: str) -> None:
+        actor = self._by_ident.get(threading.get_ident())
+        if actor is None:
+            return  # protocol call from setup/check code: not scheduled
+        actor.label = label
+        actor.state = "yielded"
+        self._ready.release()
+        actor.go.acquire()
+        actor.label = None
+        if actor.crash_pending:
+            raise ActorCrash(label)
+
+    def _run_actor(self, actor: _Actor) -> None:
+        actor.go.acquire()
+        if actor.crash_pending:
+            actor.state = "crashed"
+            self._ready.release()
+            return
+        try:
+            actor.fn()
+            actor.state = "finished"
+        except ActorCrash:
+            actor.state = "crashed"
+        except BaseException as exc:  # real failure: surfaces in report
+            actor.state = "failed"
+            actor.error = exc
+        finally:
+            self._ready.release()
+
+    # ------------------------------------------------------- stepping
+
+    def start(self) -> None:
+        self._previous_hook = sched.install_hook(self._hook)
+        for name in sorted(self._actors):
+            actor = self._actors[name]
+            actor.thread = threading.Thread(
+                target=self._run_actor,
+                args=(actor,),
+                name="schedcheck-%s" % name,
+                daemon=True,
+            )
+            actor.thread.start()
+            self._by_ident[actor.thread.ident] = actor
+
+    def enabled(self) -> List[str]:
+        return sorted(
+            name
+            for name, actor in self._actors.items()
+            if actor.state in ("ready", "yielded")
+        )
+
+    def at_seam(self, name: str) -> bool:
+        return self._actors[name].state == "yielded"
+
+    def grant(self, name: str, crash: bool = False) -> None:
+        actor = self._actors[name]
+        if crash:
+            actor.crash_pending = True
+        actor.go.release()
+        if not self._ready.acquire(timeout=_GRANT_TIMEOUT_SECS):
+            states = {
+                n: "%s@%s" % (a.state, a.label) if a.label else a.state
+                for n, a in self._actors.items()
+            }
+            raise ExplorationError(
+                "actor %r did not yield/finish within %.0fs — a blocking "
+                "call without a seam, or a real deadlock (states: %s)"
+                % (name, _GRANT_TIMEOUT_SECS, states)
+            )
+
+    def terminate(self) -> None:
+        """Crashes every still-parked actor (depth-truncated schedule)
+        and joins all threads."""
+        try:
+            while True:
+                parked = [
+                    a
+                    for a in self._actors.values()
+                    if a.state in ("ready", "yielded")
+                ]
+                if not parked:
+                    break
+                for actor in parked:
+                    self.grant(actor.name, crash=True)
+        finally:
+            for actor in self._actors.values():
+                if actor.thread is not None:
+                    actor.thread.join(timeout=_GRANT_TIMEOUT_SECS)
+            sched.uninstall_hook(self._previous_hook)
+
+    def failures(self) -> Dict[str, BaseException]:
+        return {
+            name: actor.error
+            for name, actor in self._actors.items()
+            if actor.state == "failed"
+        }
+
+    def crashed(self) -> List[str]:
+        return sorted(
+            name
+            for name, actor in self._actors.items()
+            if actor.state == "crashed"
+        )
+
+
+class Explorer:
+    """DFS over choice traces of one protocol model.
+
+    `build` returns a fresh system per execution:
+      {
+        "actors":    {name: zero-arg callable},       # required
+        "check":     callable(ctx) raising AssertionError,  # required
+        "crashable": iterable of actor names,          # optional
+      }
+    `check` receives a `CheckContext` describing the completed run;
+    safety invariants should always be asserted, liveness invariants
+    only when `ctx.truncated` is False.
+    """
+
+    def __init__(
+        self,
+        build: Callable[[], dict],
+        max_schedules: int = 2000,
+        max_depth: Optional[int] = 80,
+        max_crashes: int = 0,
+        crash_labels: Optional[Sequence[str]] = None,
+        stop_on_first: bool = True,
+        model_name: str = "",
+        mutant_name: Optional[str] = None,
+    ):
+        self._build = build
+        self._max_schedules = max_schedules
+        self._max_depth = max_depth
+        self._max_crashes = max_crashes
+        self._crash_labels = (
+            None if crash_labels is None else frozenset(crash_labels)
+        )
+        self._stop_on_first = stop_on_first
+        self._model = model_name
+        self._mutant = mutant_name
+
+    # ---------------------------------------------------- one schedule
+
+    def _choices(
+        self,
+        execution: _Execution,
+        crashes_used: int,
+        crashable: frozenset,
+    ) -> List[str]:
+        steps = ["step:%s" % name for name in execution.enabled()]
+        crashes: List[str] = []
+        if crashes_used < self._max_crashes:
+            for name in execution.enabled():
+                if name not in crashable or not execution.at_seam(name):
+                    continue
+                label = execution._actors[name].label
+                if self._crash_labels is not None and (
+                    label not in self._crash_labels
+                ):
+                    continue
+                crashes.append("crash:%s" % name)
+        return steps + crashes
+
+    def _execute(self, prefix: Tuple[str, ...]):
+        setup = self._build()
+        try:
+            execution = _Execution(setup["actors"])
+            crashable = frozenset(setup.get("crashable", setup["actors"]))
+            trace: List[str] = []
+            branches: List[Tuple[Tuple[str, ...], List[str]]] = []
+            crashes_used = 0
+            truncated = False
+            execution.start()
+            try:
+                while True:
+                    choices = self._choices(
+                        execution, crashes_used, crashable
+                    )
+                    if not choices:
+                        break
+                    if (
+                        self._max_depth is not None
+                        and len(trace) >= self._max_depth
+                    ):
+                        truncated = True
+                        break
+                    if len(trace) < len(prefix):
+                        choice = prefix[len(trace)]
+                        if choice not in choices:
+                            raise ExplorationError(
+                                "replay diverged at depth %d: scheduled "
+                                "%r but enabled choices are %s — the "
+                                "model is not deterministic (wall-clock "
+                                "control flow, or randomness feeding "
+                                "scheduling)" % (len(trace), choice, choices)
+                            )
+                    else:
+                        choice = choices[0]
+                        if len(choices) > 1:
+                            branches.append((tuple(trace), choices[1:]))
+                    trace.append(choice)
+                    kind, name = choice.split(":", 1)
+                    if kind == "crash":
+                        crashes_used += 1
+                    execution.grant(name, crash=(kind == "crash"))
+            finally:
+                execution.terminate()
+            failures = execution.failures()
+            ctx = CheckContext(
+                trace=list(trace),
+                truncated=truncated,
+                crashed=execution.crashed(),
+                failures=failures,
+            )
+            violation: Optional[Violation] = None
+            if failures:
+                violation = Violation(
+                    model=self._model,
+                    mutant=self._mutant,
+                    message="actor failure: %s"
+                    % "; ".join(
+                        "%s: %s: %s" % (n, type(e).__name__, e)
+                        for n, e in sorted(failures.items())
+                    ),
+                    trace=list(trace),
+                )
+            else:
+                try:
+                    setup["check"](ctx)
+                except AssertionError as exc:
+                    violation = Violation(
+                        model=self._model,
+                        mutant=self._mutant,
+                        message=str(exc),
+                        trace=list(trace),
+                    )
+            return trace, branches, truncated, violation
+        finally:
+            cleanup = setup.get("cleanup")
+            if cleanup is not None:
+                cleanup()
+
+    # ------------------------------------------------------------- DFS
+
+    def explore(self) -> Report:
+        stack: List[Tuple[str, ...]] = [()]
+        schedules = 0
+        truncated_schedules = 0
+        max_trace_len = 0
+        violations: List[Violation] = []
+        while stack and schedules < self._max_schedules:
+            prefix = stack.pop()
+            trace, branches, truncated, violation = self._execute(prefix)
+            schedules += 1
+            truncated_schedules += 1 if truncated else 0
+            max_trace_len = max(max_trace_len, len(trace))
+            if violation is not None:
+                violations.append(violation)
+                if self._stop_on_first:
+                    break
+            # LIFO + reversed => alternatives explored in listed order.
+            for done_trace, alts in reversed(branches):
+                for alt in reversed(alts):
+                    stack.append(done_trace + (alt,))
+        return Report(
+            model=self._model,
+            mutant=self._mutant,
+            schedules=schedules,
+            truncated_schedules=truncated_schedules,
+            max_trace_len=max_trace_len,
+            violations=violations,
+            exhausted=not stack,
+        )
+
+
+@dataclasses.dataclass
+class CheckContext:
+    """What the invariant checker sees after one completed schedule."""
+
+    trace: List[str]
+    truncated: bool
+    crashed: List[str]
+    failures: Dict[str, BaseException]

@@ -1,0 +1,492 @@
+"""Protocol models: the real coordination objects under the explorer.
+
+Each model builds a fresh instance of the *live* protocol classes —
+`WorkQueue`, `FlipParticipant`, `ArtifactStore` + `leases`/`gc` — wires
+them to injectable clocks and an in-memory or tmpdir substrate, and
+returns actors whose interleavings the explorer enumerates through the
+`sched_point` seams in the protocol sources. Invariants are asserted on
+the end state of every schedule.
+
+Time is a FakeClock; actors that would poll in production advance it
+when (and only when) they observe no progress — the schedule explorer
+therefore also enumerates *when* time passes relative to every other
+actor's steps, which is how lease expiry, lead-token takeover, and
+ready timeouts get explored without sleeps.
+
+The `MODELS` registry binds each model to the seam labels it exercises,
+the source files those seams live in, and the mutants it must kill.
+`tests/test_schedcheck.py` cross-checks all three (the JL015 registry
+discipline applied to schedules): a label with no live seam, a model
+with no mutant, or a mutant with no kill all fail the suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class FakeClock:
+    """Injectable, explicitly advanced clock (the mocked-clock idiom)."""
+
+    def __init__(self, now: float):
+        self.now = float(now)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += float(dt)
+
+
+class SpyKV:
+    """Wraps a KV, recording every successful `set` for invariants."""
+
+    def __init__(self, kv):
+        self._kv = kv
+        self.sets: List[Tuple[str, bool, bool]] = []  # (key, overwrite, won)
+
+    def set(self, key: str, value, overwrite: bool = True) -> bool:
+        won = self._kv.set(key, value, overwrite=overwrite)
+        self.sets.append((key, overwrite, won))
+        return won
+
+    def get(self, key, timeout_secs):
+        return self._kv.get(key, timeout_secs)
+
+    def try_get(self, key):
+        return self._kv.try_get(key)
+
+    def scan(self, prefix):
+        return self._kv.scan(prefix)
+
+    def delete(self, key):
+        return self._kv.delete(key)
+
+    def successful_writes(self, suffix: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for key, _overwrite, won in self.sets:
+            if won and key.endswith(suffix):
+                out[key] = out.get(key, 0) + 1
+        return out
+
+
+# ------------------------------------------------------------------ flip
+
+
+class _StubRecord:
+    def __init__(self, path: str):
+        self.path = path
+        self.iteration_number = int(os.path.basename(path).split("-")[1])
+
+    def program(self, features):  # canary surface, unused (stub canary)
+        return features
+
+
+class _StubPool:
+    def __init__(self):
+        self.active = None
+        self.adopted: List[int] = []
+
+    def adopt(self, record, how: str = "fleet") -> None:
+        self.active = record
+        self.adopted.append(record.iteration_number)
+
+
+def build_flip(supersede: bool = True) -> dict:
+    """Two replicas flip to gen-1; optionally gen-2 is published
+    mid-flight by a third actor, forcing the supersede path.
+
+    Invariants: the outcome key of every target receives at most one
+    successful write (exactly-one fleet decision); on non-truncated
+    schedules SOME flip resolves even when one replica crashed
+    mid-protocol. (Not "gen-1 resolves": the skip-to-newest rule
+    legitimately never decides gen-1 when gen-2 lands before any
+    replica latches it.)
+    """
+    from adanet_tpu.distributed.scheduler import InMemoryKV
+    from adanet_tpu.serving.fleet import flip_coordinator as fc
+
+    tmp = tempfile.mkdtemp(prefix="schedcheck-flip-")
+    os.makedirs(os.path.join(tmp, "serving", "gen-1"))
+    kv = SpyKV(InMemoryKV())
+    clock = FakeClock(1000.0)
+    config = fc.FlipConfig(lead_ttl_secs=30.0, ready_timeout_secs=60.0)
+    replicas = ("r1", "r2")
+    participants: Dict[str, fc.FlipParticipant] = {}
+    for rid in replicas:
+        participants[rid] = fc.FlipParticipant(
+            kv,
+            "ns",
+            rid,
+            _StubPool(),
+            tmp,
+            fresh_replicas=lambda: set(replicas),
+            stage_fn=_StubRecord,
+            canary_fn=lambda record: (True, ""),
+            sample_fn=lambda: [],
+            config=config,
+            clock=clock,
+        )
+
+    def participant_loop(rid: str) -> Callable[[], None]:
+        def run() -> None:
+            p = participants[rid]
+            idle = 0
+            for _ in range(24):
+                event = p.step()
+                if event is not None:
+                    idle = 0
+                    continue
+                if p._target is None:
+                    idle += 1
+                    if idle >= 3:
+                        return
+                else:
+                    # In-flight and blocked (foreign lead token, quorum
+                    # wait): time is what unblocks — expire tokens,
+                    # trip the ready timeout.
+                    clock.advance(16.0)
+
+        return run
+
+    def publish_gen2() -> None:
+        os.makedirs(os.path.join(tmp, "serving", "gen-2"))
+
+    actors: Dict[str, Callable[[], None]] = {
+        rid: participant_loop(rid) for rid in replicas
+    }
+    if supersede:
+        actors["pub"] = publish_gen2
+
+    def check(ctx) -> None:
+        # Spy history, not KV state: a commit's _gc_older_flips deletes
+        # superseded flip records, but the write log keeps every set.
+        writes = kv.successful_writes("/outcome")
+        for key, count in sorted(writes.items()):
+            assert count <= 1, (
+                "flip outcome %r decided %d times — the fleet saw more "
+                "than one decision for one target" % (key, count)
+            )
+        if ctx.truncated or set(replicas) <= set(ctx.crashed):
+            return  # liveness needs a surviving replica
+        assert writes, (
+            "no flip ever resolved (crashed=%s) — a surviving replica "
+            "must always drive its latched target to a decision"
+            % ctx.crashed
+        )
+
+    return {
+        "actors": actors,
+        "check": check,
+        "crashable": replicas,
+        "cleanup": lambda: shutil.rmtree(tmp, ignore_errors=True),
+    }
+
+
+# ------------------------------------------------------------ work queue
+
+
+def build_wq() -> dict:
+    """Two workers drain a one-unit queue through claim/renew/complete.
+
+    Invariants: at most one execution per (unit, attempt) — the
+    set-once claim token's whole job; every done/ marker has its
+    payload chunks on record (the chunks-before-done ordering); and on
+    non-truncated schedules the unit completes even when one worker
+    crashed anywhere (token-deadline recovery).
+    """
+    from adanet_tpu.distributed.scheduler import (
+        InMemoryKV,
+        WorkQueue,
+        WorkQueueConfig,
+        WorkUnit,
+    )
+
+    kv = SpyKV(InMemoryKV())
+    clock = FakeClock(1000.0)
+    config = WorkQueueConfig(lease_ttl_secs=15.0, max_attempts=4)
+    unit = WorkUnit(
+        kind="subnetwork", name="c0", start_step=0, num_steps=4
+    )
+    chief = WorkQueue(kv, "wq", config, worker="chief", clock=clock)
+    chief.publish([unit])
+    executions: List[Tuple[str, int, str]] = []  # (uid, attempt, worker)
+
+    def worker_loop(wid: str) -> Callable[[], None]:
+        def run() -> None:
+            queue = WorkQueue(kv, "wq", config, worker=wid, clock=clock)
+            queue.load(timeout_secs=1.0)
+            for _ in range(8):
+                if queue.drained():
+                    return
+                won = queue.claim(lambda u: True, lambda u: True)
+                if won is None:
+                    # Blocked on a live lease or a live claim token:
+                    # time is the only thing that unblocks a survivor.
+                    clock.advance(config.lease_ttl_secs + 1.0)
+                    continue
+                claimed, attempt = won
+                executions.append((claimed.uid, attempt, wid))
+                queue.complete(claimed, attempt, b"payload-bytes")
+
+        return run
+
+    actors = {wid: worker_loop(wid) for wid in ("w1", "w2")}
+
+    def check(ctx) -> None:
+        per_attempt: Dict[Tuple[str, int], int] = {}
+        for uid, attempt, _wid in executions:
+            per_attempt[(uid, attempt)] = (
+                per_attempt.get((uid, attempt), 0) + 1
+            )
+        for (uid, attempt), count in sorted(per_attempt.items()):
+            assert count <= 1, (
+                "unit %s attempt %d executed %d times — two workers "
+                "won the same claim" % (uid, attempt, count)
+            )
+        done = kv.try_get("wq/done/%s" % unit.uid)
+        if done is not None:
+            record = json.loads(
+                done.decode() if isinstance(done, bytes) else done
+            )
+            nchunks = kv.try_get(
+                "wq/state/%s/%d/n" % (unit.uid, int(record["attempt"]))
+            )
+            assert nchunks is not None, (
+                "done marker for %s (attempt %s) has no payload chunks "
+                "— completion published before its payload"
+                % (unit.uid, record["attempt"])
+            )
+        if ctx.truncated or {"w1", "w2"} <= set(ctx.crashed):
+            return  # liveness needs a surviving worker
+        assert done is not None, (
+            "unit %s never completed (crashed=%s, executions=%s) — a "
+            "single worker crash must not strand the queue"
+            % (unit.uid, ctx.crashed, executions)
+        )
+
+    return {"actors": actors, "check": check, "crashable": ("w1", "w2")}
+
+
+# ---------------------------------------------------------- store claims
+
+
+def build_store_ref() -> dict:
+    """Two publishers race one ref name on a shared store root.
+
+    Invariant: every surviving publisher returns the SAME document, and
+    it is the one on disk (set-once adoption) — a lost `os.link` race
+    must adopt the winner, never clobber it.
+    """
+    from adanet_tpu.store.blobstore import ArtifactStore
+
+    tmp = tempfile.mkdtemp(prefix="schedcheck-ref-")
+    clock = FakeClock(1000.0)
+    results: Dict[str, dict] = {}
+    payload = b"frozen-subnetwork-payload"
+    store_main = ArtifactStore(tmp, clock=clock)
+
+    def writer(wid: str) -> Callable[[], None]:
+        def run() -> None:
+            store = ArtifactStore(tmp, clock=clock)
+            digest = store.put(payload)
+            results[wid] = store.put_ref(
+                "frozen",
+                "arch-0",
+                {"frozen.msgpack": digest},
+                meta={"writer": wid},
+                sources=["/exports/%s/frozen.msgpack" % wid],
+            )
+
+        return run
+
+    actors = {wid: writer(wid) for wid in ("w1", "w2")}
+
+    def check(ctx) -> None:
+        final = store_main.get_ref("frozen", "arch-0")
+        docs = [results[w] for w in sorted(results)]
+        for doc in docs:
+            assert doc == docs[0] and doc == final, (
+                "racing put_ref returned diverging documents "
+                "(writers saw %s, disk has %s) — the set-once claim "
+                "must make every publisher adopt one winner"
+                % (
+                    sorted(
+                        (w, d["sources"]) for w, d in results.items()
+                    ),
+                    final and final["sources"],
+                )
+            )
+        if ctx.truncated:
+            return
+        if len(ctx.crashed) < 2:
+            assert final is not None, (
+                "no ref landed although a publisher survived "
+                "(crashed=%s)" % ctx.crashed
+            )
+
+    return {
+        "actors": actors,
+        "check": check,
+        "crashable": ("w1", "w2"),
+        "cleanup": lambda: shutil.rmtree(tmp, ignore_errors=True),
+    }
+
+
+# ------------------------------------------------------------ gc vs lease
+
+
+def build_gc_lease() -> dict:
+    """A lease holder, the passage of time, and a GC pass interleave.
+
+    The blob is old enough to sweep (the fake clock starts two hours
+    past its mtime; grace is one hour), so ONLY the lease protects it.
+    Invariant: if any pin (acquire/renew) succeeded with an expiry
+    beyond the GC pass's `now`, the blob exists at the end — a holder
+    that was *told* its pin holds must never lose bytes to that pass.
+    The unmutated path survives every order because an expired renew
+    raises `LeaseExpiredError`, and the holder's recovery re-acquires
+    AND re-verifies (healing the blob if a concurrent sweep won), while
+    GC re-checks pins at the unlink seam.
+    """
+    from adanet_tpu.store import gc as gc_mod
+    from adanet_tpu.store import leases
+    from adanet_tpu.store.blobstore import ArtifactStore
+
+    tmp = tempfile.mkdtemp(prefix="schedcheck-gc-")
+    clock = FakeClock(time.time() + 7200.0)
+    store = ArtifactStore(tmp, clock=clock)
+    payload = b"pinned-artifact-bytes"
+    digest = store.put(payload)
+    lease = leases.acquire(
+        store, "holder", ttl_secs=50.0, digests=[digest], lease_id="h-1"
+    )
+    pins: List[float] = [lease.expires_at]
+    gc_nows: List[float] = []
+
+    def holder() -> None:
+        try:
+            leases.renew(store, lease, 50.0)
+            pins.append(lease.expires_at)
+        except leases.LeaseExpiredError:
+            # The pin lapsed and the holder was told: re-acquire, then
+            # re-verify the artifact (a sweep may have won the gap).
+            fresh = leases.acquire(
+                store, "holder", ttl_secs=50.0, digests=[digest],
+                lease_id="h-1",
+            )
+            try:
+                store.get(digest)
+            except Exception:
+                store.put(payload)
+            pins.append(fresh.expires_at)
+
+    def pass_time() -> None:
+        clock.advance(60.0)  # beyond the lease TTL
+
+    def run_gc() -> None:
+        gc_nows.append(clock())
+        gc_mod.collect(store, grace_secs=3600.0)
+
+    actors = {"holder": holder, "clock": pass_time, "gc": run_gc}
+
+    def check(ctx) -> None:
+        exists = os.path.exists(store.blob_path(digest))
+        if exists:
+            return
+        covering = [
+            expiry
+            for expiry in pins
+            if all(expiry > now for now in gc_nows)
+        ]
+        assert not covering, (
+            "lease-pinned blob evicted: holder holds a pin to %s "
+            "covering every GC pass (%s), yet the blob is gone"
+            % (max(covering), gc_nows)
+        )
+
+    return {
+        "actors": actors,
+        "check": check,
+        "crashable": ("holder", "gc"),
+        "cleanup": lambda: shutil.rmtree(tmp, ignore_errors=True),
+    }
+
+
+# -------------------------------------------------------------- registry
+
+
+@dataclasses.dataclass
+class ProtocolModel:
+    """One protocol under schedule exploration, with its audit trail."""
+
+    name: str
+    build: Callable[[], dict]
+    description: str
+    #: Seam labels this model's schedules can park actors at.
+    seam_labels: Tuple[str, ...]
+    #: Repo-relative sources that must contain those sched_point calls.
+    seam_modules: Tuple[str, ...]
+    #: Mutants (tools/schedcheck/mutants.py) this model must kill.
+    mutants: Tuple[str, ...]
+    #: Explorer knobs for the bounded (tier-1) invariant run.
+    max_schedules: int = 400
+    max_crashes: int = 1
+
+
+MODELS: Dict[str, ProtocolModel] = {
+    m.name: m
+    for m in [
+        ProtocolModel(
+            name="flip",
+            build=build_flip,
+            description="fleet flip: leadership, decide, supersede",
+            seam_labels=("flip.lead_claim", "flip.decide_write"),
+            seam_modules=(
+                "adanet_tpu/serving/fleet/flip_coordinator.py",
+            ),
+            mutants=("flip.outcome_overwrite",),
+        ),
+        ProtocolModel(
+            name="wq",
+            build=build_wq,
+            description="work queue: claim token, lease, complete",
+            seam_labels=(
+                "wq.claim_token_won",
+                "wq.renew_checked",
+                "wq.complete_before_done",
+            ),
+            seam_modules=("adanet_tpu/distributed/scheduler.py",),
+            mutants=("wq.skip_claim_token", "wq.done_before_chunks"),
+        ),
+        ProtocolModel(
+            name="store_ref",
+            build=build_store_ref,
+            description="store refs: staged write, os.link set-once",
+            seam_labels=("ref.link_claim",),
+            seam_modules=("adanet_tpu/store/blobstore.py",),
+            mutants=("ref.replace_claim",),
+        ),
+        ProtocolModel(
+            name="gc_lease",
+            build=build_gc_lease,
+            description="GC mark/sweep vs lease renew/expiry",
+            seam_labels=(
+                "lease.renew_write",
+                "gc.mark_done",
+                "gc.before_unlink",
+            ),
+            seam_modules=(
+                "adanet_tpu/store/leases.py",
+                "adanet_tpu/store/gc.py",
+            ),
+            mutants=("lease.renew_after_expiry", "gc.ignore_pins"),
+        ),
+    ]
+}

@@ -1,0 +1,268 @@
+"""Seeded known-bad protocol variants schedcheck must kill.
+
+Each mutant replaces one real protocol function with a variant that
+drops exactly one safety ingredient — the set-once claim, the expiry
+check, the write ordering — while keeping the yield seams so the
+explorer can still park actors inside the (now unguarded) window. A
+mutant is *killed* when the explorer finds at least one schedule whose
+invariant check fails; `tests/test_schedcheck.py` requires a kill for
+every mutant registered here, which is what gives the green unmutated
+runs their meaning.
+
+Mutants patch module/class attributes and restore them afterwards
+(`apply()` returns the restore callable); they are process-global, so
+apply one at a time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Callable, Dict, Optional
+
+from adanet_tpu.robustness.sched import sched_point
+
+
+@dataclasses.dataclass
+class Mutant:
+    mutant_id: str
+    model: str  #: the model (tools/schedcheck/models.py) that kills it
+    description: str
+    apply: Callable[[], Callable[[], None]]  #: returns restore()
+
+
+def _patch(owner, attr: str, value) -> Callable[[], None]:
+    original = getattr(owner, attr)
+
+    def restore() -> None:
+        setattr(owner, attr, original)
+
+    setattr(owner, attr, value)
+    return restore
+
+
+# ------------------------------------------------------------------ flip
+
+
+def _apply_flip_outcome_overwrite() -> Callable[[], None]:
+    """Drops the set-once discipline on the flip outcome: `_decide`
+    writes with overwrite=True, so a concurrent decider (a superseding
+    replica, a successor leader) is silently clobbered instead of
+    losing the race — two fleet-wide decisions land for one target."""
+    from adanet_tpu.serving.fleet import flip_coordinator as fc
+
+    def _decide_overwrite(self, keys, decision, reason, participants=None):
+        sched_point("flip.decide_write")
+        self._kv.set(
+            keys.outcome,
+            json.dumps(
+                {
+                    "decision": decision,
+                    "reason": reason,
+                    "replica": self.replica_id,
+                    "participants": participants or [],
+                }
+            ),
+            overwrite=True,  # MUTATION: raw overwrite of the outcome
+        )
+        outcome = fc._json(self._kv.try_get(keys.outcome))
+        if outcome is None:
+            return None
+        return self._apply(keys, outcome)
+
+    return _patch(fc.FlipParticipant, "_decide", _decide_overwrite)
+
+
+# ------------------------------------------------------------ work queue
+
+
+def _apply_wq_done_before_chunks() -> Callable[[], None]:
+    """Reorders `complete`: the done marker lands BEFORE the payload
+    chunks. A crash in between publishes a completion whose payload
+    never arrives — readers of done/ hang or fail on state/."""
+    from adanet_tpu.distributed import scheduler as sched_mod
+
+    def complete_done_first(self, unit, attempt, blob):
+        won = self._kv.set(
+            self._key("done", unit.uid),
+            json.dumps({"owner": self.worker, "attempt": attempt}),
+            overwrite=False,  # MUTATION: done marker first ...
+        )
+        sched_point("wq.complete_before_done")
+        if blob is not None:  # ... payload after the crash window
+            prefix = self._key("state", unit.uid, attempt)
+            nchunks = max(1, -(-len(blob) // sched_mod._KV_CHUNK_BYTES))
+            for i in range(nchunks):
+                self._kv.set(
+                    "%s/%d" % (prefix, i),
+                    blob[
+                        i
+                        * sched_mod._KV_CHUNK_BYTES : (i + 1)
+                        * sched_mod._KV_CHUNK_BYTES
+                    ],
+                )
+            self._kv.set("%s/n" % prefix, str(nchunks))
+        if won:
+            self._m_completions.inc()
+        return won
+
+    return _patch(sched_mod.WorkQueue, "complete", complete_done_first)
+
+
+def _apply_wq_skip_claim_token() -> Callable[[], None]:
+    """Drops the set-once claim token: a claimant writes its lease
+    without first winning claim/<uid>/<n>, so two workers can both
+    believe they own the same attempt — double execution of a
+    non-idempotent unit."""
+    from adanet_tpu.distributed import scheduler as sched_mod
+
+    def claim_attempt_no_token(self, unit, attempt):
+        if attempt >= self.config.max_attempts:
+            return None
+        # MUTATION: no set-once token — straight to the lease write.
+        sched_point("wq.claim_token_won")
+        self._write_lease(unit, attempt)
+        return attempt
+
+    return _patch(
+        sched_mod.WorkQueue, "_claim_attempt", claim_attempt_no_token
+    )
+
+
+# ----------------------------------------------------------- store lease
+
+
+def _apply_lease_renew_after_expiry() -> Callable[[], None]:
+    """Reverts the expiry check in `leases.renew`: an expired lease is
+    silently resurrected, so a holder whose pin lapsed (and whose blobs
+    GC may have swept in the gap) never learns it must re-acquire and
+    re-verify."""
+    from adanet_tpu.store import leases
+
+    def renew_no_expiry_check(store, lease, ttl_secs, add_digests=()):
+        # MUTATION: no `now > lease.expires_at` check.
+        lease.digests = sorted(set(lease.digests) | set(add_digests))
+        lease.expires_at = float(store.clock()) + float(ttl_secs)
+        sched_point("lease.renew_write")
+        leases._write_lease(store, lease)
+        return lease
+
+    return _patch(leases, "renew", renew_no_expiry_check)
+
+
+# ----------------------------------------------------------- store claim
+
+
+def _apply_ref_replace_claim() -> Callable[[], None]:
+    """Swaps the `os.link` set-once claim in `put_ref` for
+    `os.replace`: the LAST writer wins, so two racing publishers return
+    different documents for the same ref name."""
+    from adanet_tpu.store import blobstore
+    from adanet_tpu.store import keys as store_keys
+
+    def put_ref_replace(self, kind, name, blobs, meta=None, sources=()):
+        for filename, digest in blobs.items():
+            if not store_keys.is_digest(digest):
+                raise ValueError(
+                    "blob entry %r -> %r is not a digest"
+                    % (filename, digest)
+                )
+        final = self.ref_path(kind, name)
+        os.makedirs(os.path.dirname(final), exist_ok=True)
+        existing = self.get_ref(kind, name)
+        if existing is not None:
+            return existing
+        doc = {
+            "kind": kind,
+            "name": name,
+            "blobs": dict(blobs),
+            "meta": dict(meta or {}),
+            "sources": [os.path.abspath(s) for s in sources],
+            "created_at": float(self.clock()),
+        }
+        fd, tmp = tempfile.mkstemp(dir=self.staging_dir)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            sched_point("ref.link_claim")
+            os.replace(tmp, final)  # MUTATION: last writer wins
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return doc
+
+    return _patch(blobstore.ArtifactStore, "put_ref", put_ref_replace)
+
+
+# -------------------------------------------------------------------- gc
+
+
+def _apply_gc_ignore_pins() -> Callable[[], None]:
+    """Blinds GC to leases entirely: both the mark-time pin snapshot
+    and the unlink-time re-check see no leases, so a lease-pinned blob
+    is swept like any orphan."""
+    from adanet_tpu.store import gc as gc_mod
+    from adanet_tpu.store import leases
+
+    class _NoLeases:
+        # MUTATION: gc's view of the lease dir is always empty.
+        iter_leases = staticmethod(lambda store: [])
+        release = staticmethod(leases.release)
+
+    return _patch(gc_mod, "leases_lib", _NoLeases)
+
+
+MUTANTS: Dict[str, Mutant] = {
+    m.mutant_id: m
+    for m in [
+        Mutant(
+            "flip.outcome_overwrite",
+            model="flip",
+            description="flip outcome written with overwrite=True "
+            "(set-once discipline dropped)",
+            apply=_apply_flip_outcome_overwrite,
+        ),
+        Mutant(
+            "wq.done_before_chunks",
+            model="wq",
+            description="work-queue completion publishes done/ before "
+            "the payload chunks",
+            apply=_apply_wq_done_before_chunks,
+        ),
+        Mutant(
+            "wq.skip_claim_token",
+            model="wq",
+            description="work-queue claim skips the set-once claim "
+            "token (straight to the lease write)",
+            apply=_apply_wq_skip_claim_token,
+        ),
+        Mutant(
+            "lease.renew_after_expiry",
+            model="gc_lease",
+            description="store lease renew silently resurrects an "
+            "expired lease (pre-fix behavior)",
+            apply=_apply_lease_renew_after_expiry,
+        ),
+        Mutant(
+            "ref.replace_claim",
+            model="store_ref",
+            description="put_ref claims with os.replace instead of "
+            "os.link (last writer wins)",
+            apply=_apply_ref_replace_claim,
+        ),
+        Mutant(
+            "gc.ignore_pins",
+            model="gc_lease",
+            description="GC ignores lease pins at mark AND at the "
+            "unlink re-check",
+            apply=_apply_gc_ignore_pins,
+        ),
+    ]
+}

@@ -280,6 +280,22 @@ class Estimator:
         tf.data's C++ runtime for free), overlapping host batch prep
         with device steps. Ordering is preserved, so training is
         unchanged bit-for-bit. 0 disables.
+      prefetch_to_device: with `prefetch_buffer` > 0, the prefetch
+        worker additionally commits each batch to the accelerator
+        (`jax.device_put`) before enqueueing — double-buffered device
+        puts that overlap the host→device transfer of batch i+1 with
+        the device step on batch i, removing the roofline's
+        `input_pull` component from the steady-state step
+        (utils/prefetch.py `DevicePrefetchIterator`). Values are
+        unchanged; only placement/timing move.
+      step_compute_dtype: when set (e.g. "bfloat16"), every candidate
+        train step casts its float feature arrays to this dtype at the
+        jit boundary (`utils/precision.py`), making the whole forward/
+        backward compute bf16 end-to-end while parameters, optimizer
+        state, batch-norm statistics, labels, example weights, logits,
+        and losses stay f32 — the TPU mixed-precision policy
+        (docs/performance.md). None (default) trains in the input
+        dtype, bit-identical to previous releases.
       log_every_steps: training-log period.
     """
 
@@ -315,6 +331,8 @@ class Estimator:
         weight_key: Optional[str] = None,
         keep_candidate_states: bool = False,
         prefetch_buffer: int = 0,
+        prefetch_to_device: bool = False,
+        step_compute_dtype=None,
         export_serving: bool = False,
         artifact_store=None,
         store_spec_extra: Optional[Dict[str, Any]] = None,
@@ -395,6 +413,7 @@ class Estimator:
         if prefetch_buffer < 0:
             raise ValueError("prefetch_buffer must be >= 0.")
         self._prefetch_buffer = int(prefetch_buffer)
+        self._prefetch_to_device = bool(prefetch_to_device)
         self._open_prefetchers: list = []
         # Training placement: a RoundRobinStrategy trains candidates on
         # disjoint submeshes; bookkeeping/evaluate/export always run
@@ -470,6 +489,7 @@ class Estimator:
             ),
             compile_cache=self._compile_cache,
             weight_key=weight_key,
+            step_compute_dtype=step_compute_dtype,
         )
 
     # ------------------------------------------------------------ properties
@@ -1219,11 +1239,17 @@ class Estimator:
         """Fresh iterator over input_fn(), prefetched when configured."""
         data_iter = iter(input_fn())
         if self._prefetch_buffer > 0:
-            from adanet_tpu.utils.prefetch import PrefetchIterator
-
-            data_iter = PrefetchIterator(
-                data_iter, buffer_size=self._prefetch_buffer
+            from adanet_tpu.utils.prefetch import (
+                DevicePrefetchIterator,
+                PrefetchIterator,
             )
+
+            cls = (
+                DevicePrefetchIterator
+                if self._prefetch_to_device
+                else PrefetchIterator
+            )
+            data_iter = cls(data_iter, buffer_size=self._prefetch_buffer)
             self._open_prefetchers.append(data_iter)
         return data_iter
 

@@ -41,6 +41,7 @@ from adanet_tpu.core.frozen import (
     FrozenSubnetwork,
     FrozenWeightedSubnetwork,
 )
+from adanet_tpu.utils import precision
 from adanet_tpu.utils.trees import tree_finite, tree_where
 
 # Member references inside an ensemble spec: ("new", builder_name) for a
@@ -226,6 +227,7 @@ class Iteration:
         collect_summaries: bool = True,
         compile_cache=None,
         weight_key: Optional[str] = None,
+        step_compute_dtype=None,
     ):
         if not ensemble_specs:
             raise ValueError("An iteration needs at least one ensemble spec.")
@@ -237,6 +239,14 @@ class Iteration:
         # weight_column analogue: per-example weights extracted from the
         # features mapping under this key feed every head loss/metric.
         self.weight_key = weight_key
+        # End-to-end bf16 policy (utils/precision.py): when set, float
+        # FEATURES are downcast to this dtype once at the train-step
+        # boundary — models then run bf16 from the first conv without
+        # re-casting per op. Labels/weights stay f32 (loss inputs), as
+        # do params and optimizer state (they are never touched here).
+        self.step_compute_dtype = precision.resolve_dtype(
+            step_compute_dtype
+        )
         self.adanet_loss_decay = float(adanet_loss_decay)
         # When False, builder summary hooks are traced out of the jitted
         # step entirely (no wasted device compute when nothing is written).
@@ -629,6 +639,21 @@ class Iteration:
         }
 
     def _train_step_impl(self, state: IterationState, batch, extra_batches):
+        # bf16 step policy: one downcast of the float features at the
+        # jit boundary (labels, example weights, and all state stay
+        # f32 — see utils/precision.py for the full list of deliberate
+        # f32 islands). No-op when step_compute_dtype is unset.
+        if self.step_compute_dtype is not None:
+            preserve = (self.weight_key,) if self.weight_key else ()
+            batch = precision.cast_batch(
+                batch, self.step_compute_dtype, preserve
+            )
+            extra_batches = {
+                name: precision.cast_batch(
+                    extra, self.step_compute_dtype, preserve
+                )
+                for name, extra in extra_batches.items()
+            }
         features, labels = batch
         # weight_key split: models see the stripped features, heads see the
         # weights (reference weight_column, ensemble_builder.py:571-583).
@@ -987,6 +1012,7 @@ class IterationBuilder:
         collect_summaries: bool = True,
         compile_cache=None,
         weight_key: Optional[str] = None,
+        step_compute_dtype=None,
     ):
         if not ensemblers:
             raise ValueError("At least one ensembler is required.")
@@ -999,6 +1025,11 @@ class IterationBuilder:
         self._collect_summaries = bool(collect_summaries)
         self._compile_cache = compile_cache
         self._weight_key = weight_key
+        # Validated here (fail at construction, not first step); the
+        # Iteration re-resolves, which is idempotent.
+        self._step_compute_dtype = precision.resolve_dtype(
+            step_compute_dtype
+        )
 
     def _ensembler_by_name(self, name: str):
         for ensembler in self._ensemblers:
@@ -1130,4 +1161,5 @@ class IterationBuilder:
             compile_cache=self._compile_cache,
             previous_ensemble=previous_ensemble,
             weight_key=self._weight_key,
+            step_compute_dtype=self._step_compute_dtype,
         )

@@ -162,11 +162,20 @@ class _DebiasedBatchNorm(nn.Module):
     "converged" is the faithful reading (ADVICE r5). Statistics
     and the normalization itself are float32 regardless of the compute
     dtype (the TPU-first bf16 rule: bf16 matmuls, f32 statistics).
+
+    `out_dtype` closes the other half of that rule: without it the BN
+    OUTPUT is f32, so everything downstream of every BN — branch adds,
+    relus, pools, concats, and the NEXT conv's input — silently runs
+    f32 and the "bf16 compute" policy only covers the convs themselves.
+    Setting `out_dtype` (the model's compute dtype) downcasts the
+    normalized result after the f32 affine, keeping the inter-op
+    tensors bf16 end-to-end. None preserves the legacy f32 output.
     """
 
     momentum: float = 0.9997
     epsilon: float = 1e-3
     warmup: float = 10.0
+    out_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, training: bool):
@@ -208,7 +217,10 @@ class _DebiasedBatchNorm(nn.Module):
             mean = jnp.where(trained, mean_ema.value, 0.0)
             var = jnp.where(trained, var_ema.value, 1.0)
         y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
-        return y * scale + bias
+        y = y * scale + bias
+        if self.out_dtype is not None:
+            y = y.astype(self.out_dtype)
+        return y
 
 
 def legacy_batch_stats_count() -> float:
@@ -227,10 +239,12 @@ def legacy_batch_stats_count() -> float:
     return warmup * momentum / (1.0 - momentum)
 
 
-def _batch_norm(x, training: bool, name: str):
+def _batch_norm(x, training: bool, name: str, dtype=None):
     # slim arg scope: decay 0.9997, epsilon 0.001 (NASNet paper defaults),
-    # with warmup-scheduled statistics (see _DebiasedBatchNorm).
-    return _DebiasedBatchNorm(name=name)(x, training)
+    # with warmup-scheduled statistics (see _DebiasedBatchNorm). `dtype`
+    # is the caller's compute dtype: statistics and the affine stay f32,
+    # only the OUTPUT is downcast so the ops between BNs run bf16 too.
+    return _DebiasedBatchNorm(name=name, out_dtype=dtype)(x, training)
 
 
 class _ConvKernel(nn.Module):
@@ -300,7 +314,9 @@ class _SepConv(nn.Module):
                     dtype=self.compute_dtype,
                     name="pointwise_%d" % layer,
                 )(x)
-            x = _batch_norm(x, training, "bn_%d" % layer)
+            x = _batch_norm(
+                x, training, "bn_%d" % layer, dtype=self.compute_dtype
+            )
             stride = 1
         return x
 
@@ -323,7 +339,9 @@ class _FactorizedReduction(nn.Module):
                 dtype=self.compute_dtype,
                 name="path_conv",
             )(x)
-            return _batch_norm(x, training, "path_bn")
+            return _batch_norm(
+                x, training, "path_bn", dtype=self.compute_dtype
+            )
         # Path 1: stride-2 avg pool (1x1 window) + 1x1 conv.
         path1 = nn.avg_pool(x, (1, 1), strides=(self.stride, self.stride))
         path1 = nn.Conv(
@@ -346,7 +364,9 @@ class _FactorizedReduction(nn.Module):
             name="path2_conv",
         )(path2)
         out = jnp.concatenate([path1, path2], axis=-1)
-        return _batch_norm(out, training, "final_path_bn")
+        return _batch_norm(
+            out, training, "final_path_bn", dtype=self.compute_dtype
+        )
 
 
 def _drop_path(x, keep_prob, rng):
@@ -405,7 +425,9 @@ class _NasNetCell(nn.Module):
                     dtype=self.compute_dtype,
                     name="%s_1x1" % name,
                 )(x)
-                x = _batch_norm(x, training, "%s_bn1" % name)
+                x = _batch_norm(
+                    x, training, "%s_bn1" % name, dtype=self.compute_dtype
+                )
         elif "pool" in operation:
             pool_type = operation.split("_")[0]
             window = int(operation.split("_")[-1].split("x")[0])
@@ -424,7 +446,9 @@ class _NasNetCell(nn.Module):
                     dtype=self.compute_dtype,
                     name="%s_1x1" % name,
                 )(x)
-                x = _batch_norm(x, training, "%s_bn1" % name)
+                x = _batch_norm(
+                    x, training, "%s_bn1" % name, dtype=self.compute_dtype
+                )
         else:
             raise ValueError("Unimplemented operation %r" % operation)
 
@@ -460,7 +484,9 @@ class _NasNetCell(nn.Module):
                 dtype=self.compute_dtype,
                 name="prev_1x1",
             )(prev_layer)
-            prev_layer = _batch_norm(prev_layer, training, "prev_bn")
+            prev_layer = _batch_norm(
+                prev_layer, training, "prev_bn", dtype=self.compute_dtype
+            )
         return prev_layer
 
     @nn.compact
@@ -474,7 +500,9 @@ class _NasNetCell(nn.Module):
             dtype=self.compute_dtype,
             name="beginning_1x1",
         )(x)
-        x = _batch_norm(x, training, "beginning_bn")
+        x = _batch_norm(
+            x, training, "beginning_bn", dtype=self.compute_dtype
+        )
 
         states = [x, prev_layer]
         for block in range(5):
@@ -537,7 +565,7 @@ class _AuxHead(nn.Module):
         x = nn.Conv(
             128, (1, 1), use_bias=False, dtype=self.compute_dtype, name="proj"
         )(x)
-        x = _batch_norm(x, training, "aux_bn0")
+        x = _batch_norm(x, training, "aux_bn0", dtype=self.compute_dtype)
         x = nn.relu(x)
         x = nn.Conv(
             768,
@@ -547,7 +575,7 @@ class _AuxHead(nn.Module):
             dtype=self.compute_dtype,
             name="full",
         )(x)
-        x = _batch_norm(x, training, "aux_bn1")
+        x = _batch_norm(x, training, "aux_bn1", dtype=self.compute_dtype)
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
         return nn.Dense(
@@ -646,7 +674,9 @@ class NasNetA(nn.Module):
                 dtype=cfg.compute_dtype,
                 name="conv0",
             )(x)
-            net = _batch_norm(net, training, "conv0_bn")
+            net = _batch_norm(
+                net, training, "conv0_bn", dtype=cfg.compute_dtype
+            )
             cell_outputs: List[Optional[jnp.ndarray]] = [None, net]
             stem_scaling = 1.0 / (
                 cfg.filter_scaling_rate**num_stem_cells
@@ -672,7 +702,9 @@ class NasNetA(nn.Module):
                 dtype=cfg.compute_dtype,
                 name="stem_conv",
             )(x)
-            net = _batch_norm(net, training, "stem_bn")
+            net = _batch_norm(
+                net, training, "stem_bn", dtype=cfg.compute_dtype
+            )
             cell_outputs = [None, net]
 
         aux_logits = None

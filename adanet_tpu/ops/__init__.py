@@ -1,6 +1,38 @@
 """Custom Pallas TPU ops for the hot paths."""
 
+from adanet_tpu.ops.cell_kernels import (
+    NORMAL_CELL,
+    REDUCTION_CELL,
+    CellSpec,
+    cell_reference,
+    fused_cell,
+    init_cell_params,
+)
 from adanet_tpu.ops.ensemble_kernels import fused_weighted_combine
 from adanet_tpu.ops.sepconv_kernels import fused_sep_conv, sep_conv_reference
+from adanet_tpu.ops.tuning import (
+    candidate_block_sizes,
+    lookup,
+    record,
+    set_default_store,
+    sweep,
+    tune_ref_name,
+)
 
-__all__ = ["fused_weighted_combine", "fused_sep_conv", "sep_conv_reference"]
+__all__ = [
+    "CellSpec",
+    "NORMAL_CELL",
+    "REDUCTION_CELL",
+    "candidate_block_sizes",
+    "cell_reference",
+    "fused_cell",
+    "fused_sep_conv",
+    "fused_weighted_combine",
+    "init_cell_params",
+    "lookup",
+    "record",
+    "sep_conv_reference",
+    "set_default_store",
+    "sweep",
+    "tune_ref_name",
+]

@@ -145,7 +145,18 @@ def _sepconv_kernel(x_ref, dw_ref, pw_ref, o_ref, *, kernel, stride, h_out, w_ou
     o_ref[...] = out.reshape(bb, h_out, w_out, -1).astype(o_ref.dtype)
 
 
-def _pallas_forward(x, dw, pw, stride: int, interpret: bool):
+def _sepconv_tune_spec(x, dw, pw, stride: int):
+    """The autotuner's workload identity for one sep-conv signature."""
+    return {
+        "x_shape": list(x.shape),
+        "dtype": str(x.dtype),
+        "kernel": int(dw.shape[0]),
+        "filters": int(pw.shape[-1]),
+        "stride": int(stride),
+    }
+
+
+def _pallas_forward(x, dw, pw, stride: int, interpret: bool, block_b=None):
     b, h, w, c = x.shape
     k = dw.shape[0]
     f = pw.shape[-1]
@@ -154,8 +165,21 @@ def _pallas_forward(x, dw, pw, stride: int, interpret: bool):
     xp = jnp.pad(x, ((0, 0), (pt, pb), (pl_, pr), (0, 0)))
     hp, wp = xp.shape[1], xp.shape[2]
 
-    bytes_per_example = 4 * (hp * wp * c + h_out * w_out * (c + f))
-    block_b = max(1, min(b, _VMEM_BUDGET // max(1, bytes_per_example)))
+    if block_b is None:
+        bytes_per_example = 4 * (hp * wp * c + h_out * w_out * (c + f))
+        block_b = max(1, min(b, _VMEM_BUDGET // max(1, bytes_per_example)))
+        # Store-persisted autotuner override (ops/tuning.py): a measured
+        # winner for this exact (shape, dtype, stride, environment) beats
+        # the static VMEM heuristic. Trace-time host work only.
+        from adanet_tpu.ops import tuning
+
+        tuned = tuning.lookup(
+            "sepconv", _sepconv_tune_spec(x, dw, pw, stride)
+        )
+        if tuned:
+            candidate = int(tuned.get("block_b", 0))
+            if 0 < candidate <= b and b % candidate == 0:
+                block_b = candidate
     while b % block_b:  # grid must tile the batch exactly
         block_b -= 1
 

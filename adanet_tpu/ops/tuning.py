@@ -1,0 +1,210 @@
+"""Store-persisted Pallas kernel autotuning (ROADMAP item 1, MFU
+campaign axis 4).
+
+Block-size/layout choices for the fused Pallas kernels
+(`ops/sepconv_kernels.py`, `ops/cell_kernels.py`) are currently derived
+from a static VMEM budget heuristic. This module makes the choice
+*measured* and *persistent*: `tools/autotune.py` sweeps the candidate
+block sizes for a (kernel, shape) workload, and the winner lands as a
+set-once `tune/` ref in the shared content-addressed artifact store —
+the same publish-once/amortize-fleet-wide contract as the `aot/`
+executable tier (docs/artifact_store.md). Every PR 13 fleet trial and
+PR 15 serving replica that traces the same kernel signature under the
+same environment then picks the tuned block size up for free, without
+re-searching.
+
+Key derivation follows `store/keys.py`:
+
+    refs/tune/<kernel>-<spec_fingerprint>-<env_fingerprint>.json
+
+- `kernel`: the kernel family name ("sepconv", "cell").
+- `spec_fingerprint`: shapes/dtypes/static params of the workload — the
+  things that change the lowered program.
+- `env_fingerprint`: (jax, jaxlib, backend, device count) — a block
+  size tuned for one backend generation must never silently apply to
+  another (the same reason the persistent XLA cache is keyed by it).
+
+The ref's meta carries the winner inline (`meta["winner"]`) so the hot
+path reads one small JSON document; the full sweep (every candidate and
+its timing) is content-addressed as a blob for audit.
+
+Lookup layering (cheapest first):
+
+1. an in-process cache (`_CACHE`) — one dict hit per trace;
+2. the default store, when one was registered via
+   `set_default_store(...)` or the `ADANET_TUNE_STORE` env var;
+3. miss: the caller keeps its static heuristic.
+
+Everything here is host-side Python executed at trace time — nothing
+lands inside a jitted program (timings use the wall clock *around*
+`block_until_ready`, never on a traced path).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from adanet_tpu.store import keys
+
+TUNE_REF_KIND = "tune"
+
+# (kernel, spec_fingerprint) -> winner config dict. Process-lifetime;
+# negative results are NOT cached so a ref published mid-run (by the
+# autotuner or another fleet member) is picked up on the next trace.
+_CACHE: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+_DEFAULT_STORE = None
+
+
+def set_default_store(store) -> None:
+    """Registers the store consulted by `lookup` (None to clear)."""
+    global _DEFAULT_STORE
+    _DEFAULT_STORE = store
+
+
+def _resolve_store():
+    if _DEFAULT_STORE is not None:
+        return _DEFAULT_STORE
+    root = os.environ.get("ADANET_TUNE_STORE")
+    if not root:
+        return None
+    from adanet_tpu.store import ArtifactStore
+
+    try:
+        return ArtifactStore(root)
+    except Exception:
+        return None
+
+
+def clear_cache() -> None:
+    """Drops the in-process lookup cache (tests)."""
+    _CACHE.clear()
+
+
+def tune_ref_name(kernel: str, spec: Dict[str, Any]) -> str:
+    """The set-once ref name for one (kernel, spec, environment)."""
+    return keys.ref_name(
+        kernel, keys.spec_fingerprint(spec), keys.env_fingerprint()
+    )
+
+
+def lookup(
+    kernel: str, spec: Dict[str, Any], store=None
+) -> Optional[Dict[str, Any]]:
+    """The tuned winner config for `spec`, or None (keep the heuristic).
+
+    Consults the in-process cache, then `store` (defaulting to the
+    registered/env store). Malformed refs degrade to None — a corrupt
+    tuning document must never break a trace.
+    """
+    cache_key = (kernel, keys.spec_fingerprint(spec))
+    hit = _CACHE.get(cache_key)
+    if hit is not None:
+        return hit
+    store = store if store is not None else _resolve_store()
+    if store is None:
+        return None
+    doc = store.get_ref(TUNE_REF_KIND, tune_ref_name(kernel, spec))
+    if not doc:
+        return None
+    winner = (doc.get("meta") or {}).get("winner")
+    if not isinstance(winner, dict):
+        return None
+    _CACHE[cache_key] = winner
+    return winner
+
+
+def record(
+    store,
+    kernel: str,
+    spec: Dict[str, Any],
+    winner: Dict[str, Any],
+    candidates: Sequence[Dict[str, Any]] = (),
+) -> Dict[str, Any]:
+    """Publishes a sweep's winner as a set-once `tune/` ref.
+
+    The full sweep (spec + every candidate timing) is stored as a
+    content-addressed blob; the ref meta carries the winner inline for
+    one-read lookups. SET-ONCE semantics are the store's: a lost race
+    adopts the first writer's winner, which this returns (and caches) —
+    so concurrent fleet members converge on one config.
+    """
+    payload = keys.canonical_json(
+        {
+            "kernel": kernel,
+            "spec": spec,
+            "winner": winner,
+            "candidates": list(candidates),
+        }
+    )
+    digest = store.put(payload)
+    doc = store.put_ref(
+        TUNE_REF_KIND,
+        tune_ref_name(kernel, spec),
+        {"sweep": digest},
+        meta={"kernel": kernel, "spec": spec, "winner": winner},
+    )
+    adopted = (doc.get("meta") or {}).get("winner", winner)
+    _CACHE[(kernel, keys.spec_fingerprint(spec))] = adopted
+    return doc
+
+
+def candidate_block_sizes(
+    batch: int, bytes_per_example: int, budget: int
+) -> List[int]:
+    """Batch-tile candidates: every divisor of `batch` whose tile fits
+    the VMEM budget, largest first (fewer grid steps preferred a
+    priori; the sweep decides empirically)."""
+    if batch < 1:
+        return []
+    fitting = []
+    for block in range(batch, 0, -1):
+        if batch % block:
+            continue
+        if block * max(1, bytes_per_example) <= budget or block == 1:
+            fitting.append(block)
+    return fitting
+
+
+def sweep(
+    run: Callable[[Dict[str, Any]], Any],
+    candidates: Sequence[Dict[str, Any]],
+    repeats: int = 2,
+    clock: Callable[[], float] = time.perf_counter,
+) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Times `run(candidate)` for each candidate; returns (winner, all).
+
+    `run` must block until the result is ready (callers wrap
+    `jax.block_until_ready`). The first invocation per candidate is a
+    discarded warmup (trace + compile); the reported time is the best
+    of `repeats` timed runs — the standard microbench estimator for a
+    noisy shared host. Candidates that raise are recorded as failed and
+    never win; at least one candidate must survive.
+    """
+    if not candidates:
+        raise ValueError("sweep needs at least one candidate")
+    results: List[Dict[str, Any]] = []
+    for cand in candidates:
+        entry = dict(cand)
+        try:
+            run(cand)  # warmup: compile/trace outside the timed window
+            best = None
+            for _ in range(max(1, repeats)):
+                started = clock()
+                run(cand)
+                elapsed = clock() - started
+                best = elapsed if best is None else min(best, elapsed)
+            entry["secs"] = best
+        except Exception as exc:
+            entry["error"] = "%s: %s" % (type(exc).__name__, exc)
+        results.append(entry)
+    survivors = [r for r in results if "secs" in r]
+    if not survivors:
+        raise RuntimeError(
+            "every tuning candidate failed: %s"
+            % "; ".join(r.get("error", "?") for r in results)
+        )
+    winner = min(survivors, key=lambda r: r["secs"])
+    return winner, results

@@ -6,15 +6,22 @@ from adanet_tpu.utils.batches import (
     batch_example_count,
     batch_metric_weight,
 )
+from adanet_tpu.utils.precision import cast_batch, cast_floats, resolve_dtype
+from adanet_tpu.utils.prefetch import DevicePrefetchIterator, PrefetchIterator
 from adanet_tpu.utils.trees import tree_finite
 from adanet_tpu.utils.trees import tree_where
 from adanet_tpu.utils.trees import tree_zeros_like
 
 __all__ = [
+    "DevicePrefetchIterator",
     "EVAL_FETCH_WINDOW",
+    "PrefetchIterator",
     "WeightedMeanAccumulator",
     "batch_example_count",
     "batch_metric_weight",
+    "cast_batch",
+    "cast_floats",
+    "resolve_dtype",
     "tree_finite",
     "tree_where",
     "tree_zeros_like",

@@ -8,11 +8,21 @@ outright (segfault observed when a cache directory was shared between
 version and topology makes stale entries unreachable instead of fatal —
 every (jax, jaxlib, backend, device-count) signature gets its own
 subdirectory under the shared base.
+
+The same failure class exists WITHIN one topology: jax's `LRUCache.put`
+writes entry bytes directly at the final key path, so a process killed
+mid-write (the chaos suites SIGKILL checkpoint/store writers by design,
+and those subprocesses share this cache) leaves a TORN entry at a live
+key — and the next process to deserialize it can segfault. Enabling the
+cache through this module therefore also installs crash-atomic entry
+writes (staged + fsync + rename, the artifact store's protocol), so a
+kill at any instant leaves either no entry or a complete one.
 """
 
 from __future__ import annotations
 
 import os
+import uuid
 
 import jax
 
@@ -35,14 +45,92 @@ def versioned_cache_dir(base: str) -> str:
     return os.path.join(base, tag)
 
 
+def _write_bytes_atomic(path: str, data: bytes) -> None:
+    """Staged + fsync + rename: `path` either absent or complete, at
+    every instant, even across SIGKILL."""
+    tmp = "%s.tmp-%d-%s" % (path, os.getpid(), uuid.uuid4().hex[:8])
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def install_atomic_cache_writes() -> bool:
+    """Replaces jax's persistent-cache entry write with a crash-atomic
+    one (see module docstring). Idempotent; returns whether the atomic
+    path is installed. If jax's cache internals have moved (different
+    version), installs nothing and returns False — the cache degrades
+    to upstream's non-atomic writes rather than breaking.
+    """
+    try:
+        from jax._src import lru_cache as _lru
+
+        cache_cls = _lru.LRUCache
+        cache_suffix = _lru._CACHE_SUFFIX
+        atime_suffix = _lru._ATIME_SUFFIX
+        original_put = cache_cls.put
+    except Exception:
+        return False
+    if getattr(original_put, "_adanet_atomic", False):
+        return True
+
+    def put(self, key, val):
+        try:
+            root = os.fspath(self.path)
+        except TypeError:
+            root = None
+        if root is None or not os.path.isdir(root):
+            # Non-local backing (e.g. a cloud bucket path): rename-based
+            # atomicity does not apply; keep upstream behavior.
+            return original_put(self, key, val)
+        if not key:
+            raise ValueError("key cannot be empty")
+        eviction = getattr(self, "eviction_enabled", False)
+        if eviction and len(val) > self.max_size:
+            # Same contract as upstream: oversized entries are dropped.
+            return original_put(self, key, val)
+        cache_path = os.path.join(root, "%s%s" % (key, cache_suffix))
+        atime_path = os.path.join(root, "%s%s" % (key, atime_suffix))
+        if eviction:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if os.path.exists(cache_path):
+                return
+            if eviction:
+                self._evict_if_needed(additional_size=len(val))
+            _write_bytes_atomic(cache_path, val)
+            import time as _time
+
+            _write_bytes_atomic(
+                atime_path, _time.time_ns().to_bytes(8, "little")
+            )
+        finally:
+            if eviction:
+                self.lock.release()
+
+    put._adanet_atomic = True
+    cache_cls.put = put
+    return True
+
+
 def enable_persistent_cache(base: str, min_compile_secs: float = 1.0) -> str:
     """Points jax's persistent compile cache at the versioned subdir.
 
     Returns the directory actually configured. No-op on the cache-dir
     setting if one is already configured (e.g. via
     JAX_COMPILATION_CACHE_DIR at jax import time) — an explicit caller
-    choice wins.
+    choice wins. Either way, entry writes become crash-atomic
+    (`install_atomic_cache_writes`).
     """
+    install_atomic_cache_writes()
     if jax.config.jax_compilation_cache_dir is not None:
         return jax.config.jax_compilation_cache_dir
     path = versioned_cache_dir(base)

@@ -1,0 +1,95 @@
+"""Mixed-precision step policy: bf16 compute, f32 parameters/statistics.
+
+The TPU-first rule this framework follows everywhere (models/nasnet.py,
+models/efficientnet.py, examples/simple_cnn.py) is *bf16 compute with
+f32 state*: matmuls and convolutions run in bfloat16 on the MXU, while
+parameters, optimizer state, batch-norm statistics, logits, and losses
+stay float32. This module is the one place the BATCH side of that
+policy lives: casting the incoming feature arrays to the compute dtype
+at the jit boundary (`core/iteration.py` `step_compute_dtype`), so
+
+- the f32→bf16 cast happens once per step instead of once per conv, and
+- the first convolution's HBM read of the input halves.
+
+Deliberately f32 (never cast here or anywhere on the policy's path):
+
+- labels and example weights — loss inputs (`core/heads.py` computes
+  every loss in f32);
+- integer/bool features (not floating point at all);
+- anything already narrower than f32 (never widen: an f16/bf16 input
+  stays what it is — widening would be a silent upcast on the hot path,
+  exactly what jaxlint JL010 polices).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree, dtype, preserve_keys: Sequence[str] = ()):
+    """Casts wide floating-point leaves of `tree` to `dtype`.
+
+    Only leaves whose itemsize EXCEEDS the target's are cast (downcast
+    only — integers, bools, and already-narrow floats pass through).
+    Top-level dict keys named in `preserve_keys` (the example-weight
+    column) are left untouched. `dtype=None` is the identity.
+    """
+    if dtype is None:
+        return tree
+    target = jnp.dtype(dtype)
+
+    def cast(leaf):
+        leaf_dtype = getattr(leaf, "dtype", None)
+        if leaf_dtype is None:
+            return leaf
+        if not jnp.issubdtype(leaf_dtype, jnp.floating):
+            return leaf
+        if jnp.dtype(leaf_dtype).itemsize <= target.itemsize:
+            return leaf
+        return leaf.astype(target)
+
+    if isinstance(tree, dict) and preserve_keys:
+        preserved = {
+            k: v for k, v in tree.items() if k in preserve_keys
+        }
+        rest = {
+            k: v for k, v in tree.items() if k not in preserve_keys
+        }
+        out = jax.tree_util.tree_map(cast, rest)
+        out.update(preserved)
+        return out
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def cast_batch(batch, dtype, preserve_keys: Sequence[str] = ()):
+    """Casts a (features, labels) batch's float features to `dtype`.
+
+    Labels are NEVER cast (loss inputs stay f32; integer class labels
+    pass through untouched anyway). Non-tuple batches are cast as a
+    feature tree.
+    """
+    if dtype is None:
+        return batch
+    if isinstance(batch, tuple) and len(batch) == 2:
+        features, labels = batch
+        return (cast_floats(features, dtype, preserve_keys), labels)
+    return cast_floats(batch, dtype, preserve_keys)
+
+
+def resolve_dtype(dtype: Optional[Any]):
+    """Normalizes a user-facing dtype knob: None stays None, strings
+    ("bfloat16") and dtype-likes become jnp dtypes; rejects non-float
+    targets early (a step cast to int would corrupt training silently).
+    """
+    if dtype is None:
+        return None
+    resolved = jnp.dtype(dtype)
+    if not jnp.issubdtype(resolved, jnp.floating):
+        raise ValueError(
+            "step_compute_dtype must be a floating dtype, got %r"
+            % (dtype,)
+        )
+    return resolved

@@ -1,0 +1,175 @@
+"""Exit-contract and store-hit tests for tools/autotune.py (ISSUE 17).
+
+The contract (the ckpt_fsck/fleetctl/servectl convention):
+  0  every workload already tuned (pure store hit)
+  1  at least one sweep ran (or would run, under --dry-run)
+  2  a sweep failed or the store is unusable
+  64 usage errors
+
+The tier-1 smoke proves the set-once `tune/` ref lifecycle end to end:
+first invocation sweeps and publishes (exit 1), the second is a PURE
+store hit (exit 0, zero re-searches) — with the in-process memo cleared
+between runs so the hit is the store's, not a process-local cache.
+"""
+
+import json
+
+import pytest
+
+from adanet_tpu.ops import tuning
+from tools import autotune
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuning_state():
+    tuning.clear_cache()
+    tuning.set_default_store(None)
+    yield
+    tuning.clear_cache()
+    tuning.set_default_store(None)
+
+
+def _run(capsys, *argv):
+    rc = autotune.main(list(argv))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_usage_error_exits_64(capsys):
+    with pytest.raises(SystemExit) as e:
+        autotune.main([])  # --store is required
+    assert e.value.code == 64
+    with pytest.raises(SystemExit) as e:
+        autotune.main(["--store", "x", "--kernel", "nonsense"])
+    assert e.value.code == 64
+
+
+def test_unusable_store_exits_2(tmp_path, capsys):
+    path = tmp_path / "not_a_dir"
+    path.write_text("a file where the store root should be")
+    rc = autotune.main(
+        ["--store", str(path), "--preset", "tiny", "--interpret"]
+    )
+    assert rc == 2
+
+
+def test_first_run_sweeps_second_run_pure_store_hit(tmp_path, capsys):
+    store = str(tmp_path / "store")
+    argv = ["--store", store, "--preset", "tiny", "--interpret", "--json"]
+
+    rc1, out1 = _run(capsys, *argv)
+    report1 = json.loads(out1)
+    assert rc1 == 1, report1
+    assert report1["exit_code"] == 1
+    assert report1["searched"] == 2  # one sepconv + one cell workload
+    assert report1["hits"] == 0
+    assert report1["failed"] == 0
+    for entry in report1["workloads"]:
+        assert entry["status"] == "tuned", entry
+        assert entry["winner"]["block_b"] >= 1
+        assert entry["winner"]["interpret"] is True
+        assert entry["ref"].startswith(entry["kernel"] + "-")
+
+    # The second invocation must hit the STORE, not the in-process memo.
+    tuning.clear_cache()
+    rc2, out2 = _run(capsys, *argv)
+    report2 = json.loads(out2)
+    assert rc2 == 0, report2
+    assert report2["searched"] == 0
+    assert report2["hits"] == 2
+    assert report2["failed"] == 0
+    for entry in report2["workloads"]:
+        assert entry["status"] == "hit", entry
+        assert entry["winner"]["block_b"] >= 1
+
+
+def test_dry_run_reports_pending_without_writing(tmp_path, capsys):
+    store = str(tmp_path / "store")
+    argv = [
+        "--store", store, "--preset", "tiny", "--interpret", "--json",
+    ]
+
+    rc, out = _run(capsys, *argv, "--dry-run")
+    report = json.loads(out)
+    assert rc == 1, report
+    assert report["pending"] == 2
+    assert report["searched"] == 0
+    for entry in report["workloads"]:
+        assert entry["status"] == "pending"
+        assert entry["candidates"], entry
+
+    # Nothing was published: a real run still has everything to do.
+    rc, out = _run(capsys, *argv)
+    assert rc == 1
+    assert json.loads(out)["searched"] == 2
+
+    # A dry run over a fully-tuned store is clean (exit 0).
+    tuning.clear_cache()
+    rc, out = _run(capsys, *argv, "--dry-run")
+    report = json.loads(out)
+    assert rc == 0, report
+    assert report["hits"] == 2 and report["pending"] == 0
+
+
+def test_kernel_filter_tunes_one_family(tmp_path, capsys):
+    store = str(tmp_path / "store")
+    rc, out = _run(
+        capsys,
+        "--store", store, "--preset", "tiny", "--interpret", "--json",
+        "--kernel", "sepconv",
+    )
+    report = json.loads(out)
+    assert rc == 1
+    assert [e["kernel"] for e in report["workloads"]] == ["sepconv"]
+
+
+def test_sweep_requires_a_survivor():
+    """tuning.sweep: every candidate failing is unrecoverable (exit 2
+    at the CLI); partial failures are recorded but tolerated."""
+
+    def always_broken(cand):
+        raise RuntimeError("no backend")
+
+    with pytest.raises(RuntimeError):
+        tuning.sweep(always_broken, [{"block_b": 1}, {"block_b": 2}])
+
+    def half_broken(cand):
+        if cand["block_b"] == 2:
+            raise RuntimeError("bad block")
+
+    winner, results = tuning.sweep(
+        half_broken, [{"block_b": 1}, {"block_b": 2}]
+    )
+    assert winner["block_b"] == 1
+    by_block = {r["block_b"]: r for r in results}
+    assert "error" in by_block[2]
+    assert by_block[1]["secs"] >= 0
+
+
+def test_candidate_block_sizes_respect_budget():
+    # 8 examples at 100 bytes each against an 850-byte budget: blocks
+    # of 8 would need 800 <= 850 (fits); every divisor rides along,
+    # largest first.
+    assert tuning.candidate_block_sizes(8, 100, 850) == [8, 4, 2, 1]
+    # A budget smaller than one example still yields block 1 (the
+    # kernel's fallback tile) rather than an empty sweep.
+    assert tuning.candidate_block_sizes(8, 1000, 850) == [1]
+
+
+def test_record_is_set_once_and_losers_adopt_winner(tmp_path):
+    from adanet_tpu.store import ArtifactStore
+
+    store = ArtifactStore(str(tmp_path / "store"))
+    spec = {"x_shape": [4, 8, 8, 8], "dtype": "float32"}
+    first = tuning.record(
+        store, "sepconv", spec, {"block_b": 4}, [{"block_b": 4, "secs": 1}]
+    )
+    assert first["meta"]["winner"]["block_b"] == 4
+    # A racing second publisher loses the ref claim and ADOPTS the
+    # winner already in the store.
+    adopted = tuning.record(
+        store, "sepconv", spec, {"block_b": 2}, [{"block_b": 2, "secs": 2}]
+    )
+    assert adopted["meta"]["winner"]["block_b"] == 4
+    tuning.clear_cache()
+    assert tuning.lookup("sepconv", spec, store=store)["block_b"] == 4

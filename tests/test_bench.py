@@ -79,6 +79,10 @@ def test_bench_prints_one_json_line():
     # (the machinery is chaos-gated in tests/test_serving_fleet.py and
     # recorded in BENCH_serving_r02.json).
     env["ADANET_BENCH_FLEET_SERVING"] = "0"
+    # The per-axis MFU-compare arms each recompile NASNet; the real
+    # machinery runs in-process in test_roofline_compare_in_process and
+    # this run asserts the structured opt-out.
+    env["ADANET_BENCH_ROOFLINE_COMPARE"] = "0"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py")],
         cwd=repo,
@@ -147,6 +151,10 @@ def test_bench_prints_one_json_line():
     fractions = roofline["fractions"]
     assert set(fractions) == {"input_pull", "device_step", "host_fetch"}
     assert sum(fractions.values()) == pytest.approx(1.0, abs=0.01)
+    # The MFU-compare section honored its structured opt-out.
+    assert result["roofline_compare"] == {
+        "skipped": "roofline_compare_disabled_by_env"
+    }
     # On CPU there is no axon tunnel: no timing caveat, no MFU peak.
     assert "timing_caveat" not in result
 
@@ -203,6 +211,9 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     # machinery is chaos-gated in tests/test_serving_fleet.py, and the
     # recorded curves live in BENCH_serving_r02.json.
     env["ADANET_BENCH_FLEET_SERVING"] = "0"
+    # And for the MFU-compare arms (4 extra model compiles): the real
+    # path runs in-process in test_roofline_compare_in_process.
+    env["ADANET_BENCH_ROOFLINE_COMPARE"] = "0"
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py")],
         cwd=repo,
@@ -248,3 +259,67 @@ def test_bench_emits_structured_skip_when_backend_unavailable():
     assert "skipped" not in roofline, roofline
     assert roofline["device_step_secs_per_step"] > 0
     assert roofline["step_clock"] == "host_fallback"
+    # The MFU-compare section honored its structured opt-out.
+    assert result["roofline_compare"] == {
+        "skipped": "roofline_compare_disabled_by_env"
+    }
+
+
+def test_roofline_compare_in_process(monkeypatch):
+    """The MFU-campaign per-axis section (ISSUE 17): every arm reports
+    the same roofline schema, deltas price each axis against the f32
+    baseline, and the two CPU-unpriceable axes carry correctness
+    verdicts (fused-cell bit-identity, autotune pure-store-hit)."""
+    import bench
+    from adanet_tpu.examples.simple_cnn import CNNBuilder
+
+    monkeypatch.delenv("ADANET_BENCH_ROOFLINE_COMPARE", raising=False)
+    monkeypatch.setattr(bench, "WARMUP_STEPS", 1)
+    monkeypatch.setattr(bench, "MEASURE_STEPS", 2)
+
+    result = bench._roofline_compare_section(
+        lambda: [CNNBuilder(num_blocks=1, channels=8)],
+        batch_size=4,
+        model_name="cnn_tiny",
+    )
+    assert "skipped" not in result, result
+
+    arms = result["arms"]
+    assert set(arms) == {
+        "baseline",
+        "bf16",
+        "overlap",
+        "bf16_overlap",
+        "fused_sepconv",
+    }
+    # No pallas builder was passed (and this is CPU): structured skip.
+    assert arms["fused_sepconv"] == {"skipped": "fused_arm_requires_tpu"}
+    for name in ("baseline", "bf16", "overlap", "bf16_overlap"):
+        arm = arms[name]
+        assert arm["device_step_secs_per_step"] > 0, (name, arm)
+        assert arm["input_pull_secs"] >= 0, (name, arm)
+    assert arms["baseline"]["step_compute_dtype"] is None
+    assert arms["bf16"]["step_compute_dtype"] == "bfloat16"
+    assert arms["overlap"]["overlap"] is True
+    assert arms["overlap"]["step_clock"] == "host_overlap"
+    assert arms["bf16_overlap"]["overlap"] is True
+
+    deltas = result["deltas_vs_baseline"]
+    assert set(deltas) == {"bf16", "overlap", "bf16_overlap"}
+    for name, delta in deltas.items():
+        assert delta["device_step_speedup"] > 0, (name, delta)
+
+    # The fused-cell axis: interpret-mode kernel bit-identical to the
+    # jitted unfused reference.
+    oracle = result["fused_cell_oracle"]
+    assert oracle["bit_identical"] is True, oracle
+    assert oracle["max_abs_diff"] == 0.0
+
+    # The autotune axis: run 1 sweeps (exit 1), run 2 is a pure store
+    # hit (exit 0, zero re-searches).
+    tune = result["autotune_store"]
+    assert tune["first_run"]["exit_code"] == 1, tune
+    assert tune["first_run"]["searched"] > 0
+    assert tune["second_run"]["exit_code"] == 0, tune
+    assert tune["second_run"]["searched"] == 0
+    assert tune["second_run_pure_store_hit"] is True, tune

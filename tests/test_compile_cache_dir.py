@@ -1,0 +1,79 @@
+"""Crash-atomic persistent-compile-cache writes (ISSUE 17 hardening).
+
+jax's `LRUCache.put` writes entry bytes directly at the final key path.
+The chaos suites SIGKILL subprocess writers by design, and those
+subprocesses share `tests/.jax_cache` — a kill landing mid-write leaves
+a TORN entry at a live key, and the next process to deserialize it can
+segfault (observed: tier-1 dying inside a compiled call after a chaos
+round). `enable_persistent_cache` therefore installs staged+fsync+
+rename entry writes; these tests pin the property that matters: the
+final path is either absent or complete, at every instant.
+"""
+
+import os
+
+import pytest
+
+from adanet_tpu.utils import compile_cache_dir as ccd
+
+
+def _make_cache(tmp_path, max_size=-1):
+    from jax._src import lru_cache
+
+    return lru_cache.LRUCache(str(tmp_path), max_size=max_size)
+
+
+def test_atomic_put_installed_and_idempotent():
+    # conftest already ran enable_persistent_cache; the seam is marked.
+    assert ccd.install_atomic_cache_writes() is True
+    from jax._src import lru_cache
+
+    assert getattr(lru_cache.LRUCache.put, "_adanet_atomic", False)
+    # Installing twice must not stack wrappers.
+    before = lru_cache.LRUCache.put
+    assert ccd.install_atomic_cache_writes() is True
+    assert lru_cache.LRUCache.put is before
+
+
+def test_put_get_roundtrip_and_no_staging_droppings(tmp_path):
+    ccd.install_atomic_cache_writes()
+    cache = _make_cache(tmp_path)
+    cache.put("key1", b"payload-bytes")
+    assert cache.get("key1") == b"payload-bytes"
+    # Set-once, like upstream: a second put of the same key is a no-op.
+    cache.put("key1", b"different")
+    assert cache.get("key1") == b"payload-bytes"
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+
+
+def test_interrupted_write_leaves_no_torn_entry(tmp_path, monkeypatch):
+    """A crash at the worst instant (bytes written, rename pending) must
+    leave NOTHING at the final path — a reader sees a miss and
+    recompiles, never a truncated executable."""
+    ccd.install_atomic_cache_writes()
+    cache = _make_cache(tmp_path)
+
+    real_replace = os.replace
+
+    def exploding_replace(src, dst):
+        raise OSError("simulated kill mid-publish")
+
+    monkeypatch.setattr(os, "replace", exploding_replace)
+    with pytest.raises(OSError, match="simulated kill"):
+        cache.put("hot-key", b"x" * 4096)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    assert cache.get("hot-key") is None  # miss, not garbage
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+    # The cache still works after the failed publish.
+    cache.put("hot-key", b"y" * 4096)
+    assert cache.get("hot-key") == b"y" * 4096
+
+
+def test_enable_persistent_cache_reports_configured_dir(tmp_path):
+    import jax
+
+    # conftest configured the cache at import; a second enable is a
+    # no-op on the directory but must still return the live setting.
+    configured = ccd.enable_persistent_cache(str(tmp_path / "unused"))
+    assert configured == jax.config.jax_compilation_cache_dir

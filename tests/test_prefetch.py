@@ -13,7 +13,10 @@ import numpy as np
 import optax
 import pytest
 
-from adanet_tpu.utils.prefetch import PrefetchIterator
+from adanet_tpu.utils.prefetch import (
+    DevicePrefetchIterator,
+    PrefetchIterator,
+)
 
 
 def test_order_preserved():
@@ -116,6 +119,136 @@ def test_close_from_other_thread_wakes_blocked_consumer():
 def test_buffer_size_validation():
     with pytest.raises(ValueError):
         PrefetchIterator(iter([]), buffer_size=0)
+
+
+class _FakeDeviceArray:
+    """Mock jax.Array at the device_put/delete seam: records deletion so
+    the shutdown leak audit can count pinned buffers."""
+
+    def __init__(self, value, log):
+        self.value = value
+        self.deleted = False
+        self._log = log
+
+    def delete(self):
+        if self.deleted:
+            raise RuntimeError("Array has already been deleted.")
+        self.deleted = True
+        self._log.append(self.value)
+
+
+def _mock_device_put(monkeypatch, log, fail_on=None):
+    """Patches DevicePrefetchIterator's _prepare seam (the class calls
+    jax.device_put; tests mock one level up to keep the audit exact)."""
+
+    def prepare(self, item):
+        if fail_on is not None and item == fail_on:
+            raise RuntimeError("device_put failed (simulated OOM)")
+        return _FakeDeviceArray(item, log)
+
+    monkeypatch.setattr(DevicePrefetchIterator, "_prepare", prepare)
+
+
+def test_device_prefetch_order_and_values(monkeypatch):
+    deleted = []
+    _mock_device_put(monkeypatch, deleted)
+    it = DevicePrefetchIterator(iter(range(10)), buffer_size=3)
+    got = [a.value for a in it]
+    assert got == list(range(10))
+    assert deleted == []  # consumed items belong to the consumer
+
+
+def test_device_prefetch_real_device_put():
+    """Unmocked smoke: real jax.device_put commits, values unchanged."""
+    import jax
+
+    batches = [
+        ({"x": np.full((2, 2), i, np.float32)}, np.array([i]))
+        for i in range(4)
+    ]
+    it = DevicePrefetchIterator(iter(batches), buffer_size=2)
+    out = list(it)
+    assert len(out) == 4
+    for i, (features, labels) in enumerate(out):
+        assert isinstance(features["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(features["x"]), i)
+        np.testing.assert_array_equal(np.asarray(labels), [i])
+
+
+def test_device_prefetch_close_releases_pinned_buffers(monkeypatch):
+    """The SIGTERM mid-search drain: close() with device-committed
+    batches still parked in the queue (and one in the worker's hand)
+    must delete every unconsumed buffer AND stop the feeder thread —
+    neither a thread nor pinned device memory may outlive the
+    iterator."""
+    deleted = []
+    _mock_device_put(monkeypatch, deleted)
+
+    prepared = []
+
+    def source():
+        for i in range(100):
+            prepared.append(i)
+            yield i
+
+    it = DevicePrefetchIterator(source(), buffer_size=2)
+    first = next(it)
+    assert first.value == 0
+
+    # Let the worker fill the buffer and park on the full queue.
+    deadline = time.time() + 5.0
+    while len(prepared) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+
+    it.close()
+    deadline = time.time() + 5.0
+    while it._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not it._thread.is_alive(), "feeder thread leaked"
+
+    # Every prepared-but-unconsumed batch was released; the consumed one
+    # was not (it belongs to the consumer now).
+    assert not first.deleted
+    assert sorted(deleted) == sorted(set(prepared) - {0}), (
+        prepared, deleted,
+    )
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_device_prefetch_double_delete_tolerated(monkeypatch):
+    """close() must swallow an already-deleted buffer (donated to a
+    step, deleted by a racing close) instead of raising mid-shutdown."""
+    deleted = []
+    _mock_device_put(monkeypatch, deleted)
+    it = DevicePrefetchIterator(iter([1, 2, 3]), buffer_size=3)
+    time.sleep(0.1)  # let the worker stage everything
+    # Simulate an external deletion of a parked buffer.
+    staged = list(it._queue.queue)
+    for kind, payload in staged:
+        if kind == "item" and payload.value == 2:
+            payload.delete()
+    it.close()  # must not raise
+    assert 2 in deleted
+
+
+def test_device_prefetch_put_failure_propagates(monkeypatch):
+    """A device_put failure (device OOM) surfaces to the consumer at the
+    position it occurred, like any source exception, and the worker
+    exits."""
+    deleted = []
+    _mock_device_put(monkeypatch, deleted, fail_on=2)
+    it = DevicePrefetchIterator(iter(range(5)), buffer_size=2)
+    assert next(it).value == 0
+    assert next(it).value == 1
+    with pytest.raises(RuntimeError, match="simulated OOM"):
+        next(it)
+    with pytest.raises(StopIteration):  # sticky after the error
+        next(it)
+    deadline = time.time() + 5.0
+    while it._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not it._thread.is_alive()
 
 
 def test_estimator_training_identical_with_prefetch(tmp_path):

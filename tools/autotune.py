@@ -1,0 +1,345 @@
+"""Pallas kernel autotuner: sweep block sizes, persist winners in the
+artifact store.
+
+Operator CLI over `adanet_tpu.ops.tuning`. For each (kernel, shape)
+workload it derives the set-once ref name
+`tune/<kernel>-<spec_fp>-<env_fp>`, and either reports the existing
+winner (a *store hit* — no search) or sweeps the candidate batch-block
+sizes, timing the kernel per candidate, and publishes the winner. Tuned
+configs are picked up automatically at the next trace
+(`ops/sepconv_kernels.py` / `ops/cell_kernels.py` consult
+`tuning.lookup` before their static VMEM heuristic), and — because refs
+are keyed by the env fingerprint and published set-once — compile once
+and amortize fleet-wide, exactly like the `aot/` executable tier.
+
+Usage:
+    python -m tools.autotune --store PATH                # tune all
+    python -m tools.autotune --store PATH --kernel sepconv
+    python -m tools.autotune --store PATH --dry-run      # report only
+    python -m tools.autotune --store PATH --json         # machine-readable
+
+On a host without a live TPU the sweep runs the kernels in Pallas
+interpret mode (`--interpret` is forced on); the timings are CPU
+proxies and the published meta records `"interpret": true` so a
+TPU-backed retune (different env fingerprint → different ref name)
+never collides with them.
+
+Exit status (the ckpt_fsck/fleetctl/servectl contract):
+    0  clean: every workload was already tuned (pure store hit, zero
+       re-searches); also a --dry-run that found nothing pending
+    1  tuned: at least one sweep ran and its winner was published
+       (or, with --dry-run, would have run)
+    2  unrecoverable: a sweep failed outright or the store is unusable
+    64 usage errors (EX_USAGE; argparse's default of 2 would collide
+       with "unrecoverable")
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+
+class _Parser(argparse.ArgumentParser):
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        self.exit(64, "%s: error: %s\n" % (self.prog, message))
+
+
+def _tiny_cell_spec():
+    from adanet_tpu.ops.cell_kernels import CellSpec
+
+    # Two blocks exercising every branch kind cheaply: one separable,
+    # one identity, one pool pair.
+    return CellSpec(
+        operations=(
+            "separable_3x3_1",
+            "none",
+            "avg_pool_3x3",
+            "none",
+        ),
+        hiddenstate_indices=(0, 1, 1, 0),
+        used_hiddenstates=(1, 1, 0, 0),
+        stride=1,
+    )
+
+
+def _sepconv_workloads(preset: str) -> List[Dict[str, Any]]:
+    if preset == "tiny":
+        return [
+            {"shape": (4, 8, 8, 8), "kernel": 3, "filters": 8, "stride": 1}
+        ]
+    # "cifar": the flagship NASNet-A (CIFAR stem) hot shapes — one
+    # normal-cell and one reduction-cell sep-conv signature.
+    return [
+        {"shape": (64, 32, 32, 32), "kernel": 5, "filters": 32, "stride": 1},
+        {"shape": (64, 32, 32, 32), "kernel": 3, "filters": 64, "stride": 2},
+    ]
+
+
+def _cell_workloads(preset: str) -> List[Dict[str, Any]]:
+    if preset == "tiny":
+        return [
+            {
+                "shape": (4, 6, 6, 8),
+                "filters": 8,
+                "spec": "tiny",
+            }
+        ]
+    return [
+        {"shape": (64, 32, 32, 32), "filters": 32, "spec": "normal"},
+        {"shape": (64, 32, 32, 32), "filters": 64, "spec": "reduction"},
+    ]
+
+
+def _resolve_cell_spec(name: str):
+    from adanet_tpu.ops import cell_kernels as ck
+
+    return {
+        "tiny": _tiny_cell_spec(),
+        "normal": ck.NORMAL_CELL,
+        "reduction": ck.REDUCTION_CELL,
+    }[name]
+
+
+def _tune_sepconv(workload, interpret: bool, repeats: int):
+    """Returns (tune_spec, candidates, run_fn) for one sep-conv shape."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from adanet_tpu.ops import sepconv_kernels as sk
+    from adanet_tpu.ops import tuning
+
+    b, h, w, c = workload["shape"]
+    k, f, stride = workload["kernel"], workload["filters"], workload["stride"]
+    xk, dk, pk = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(xk, (b, h, w, c), jnp.float32)
+    dw = jax.random.normal(dk, (k, k, 1, c), jnp.float32)
+    pw = jax.random.normal(pk, (1, 1, c, f), jnp.float32)
+    spec = sk._sepconv_tune_spec(x, dw, pw, stride)
+    h_out = -(-h // stride)
+    w_out = -(-w // stride)
+    bytes_per_example = 4 * ((h + k) * (w + k) * c + h_out * w_out * (c + f))
+    candidates = [
+        {"block_b": block}
+        for block in tuning.candidate_block_sizes(
+            b, bytes_per_example, sk._VMEM_BUDGET
+        )
+    ]
+
+    def run(cand):
+        fn = jax.jit(
+            functools.partial(
+                sk._pallas_forward,
+                stride=stride,
+                interpret=interpret,
+                block_b=cand["block_b"],
+            )
+        )
+        jax.block_until_ready(fn(x, dw, pw))
+
+    return spec, candidates, run
+
+
+def _tune_cell(workload, interpret: bool, repeats: int):
+    """Returns (tune_spec, candidates, run_fn) for one cell shape."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from adanet_tpu.ops import cell_kernels as ck
+    from adanet_tpu.ops import tuning
+
+    b, h, w, c = workload["shape"]
+    filters = workload["filters"]
+    spec = _resolve_cell_spec(workload["spec"])
+    key = jax.random.PRNGKey(0)
+    params = ck.init_cell_params(key, spec, c, c, filters)
+    prev = jax.random.normal(jax.random.PRNGKey(1), (b, h, w, c), jnp.float32)
+    cur = jax.random.normal(jax.random.PRNGKey(2), (b, h, w, c), jnp.float32)
+    tune_spec = ck._tune_spec(prev, cur, params, spec)
+    per_example = ck._bytes_per_example(spec, h, w, c, c, filters)
+    candidates = [
+        {"block_b": block}
+        for block in tuning.candidate_block_sizes(
+            b, per_example, ck._VMEM_BUDGET
+        )
+    ]
+
+    def run(cand):
+        fn = jax.jit(
+            functools.partial(
+                ck._pallas_forward,
+                spec=spec,
+                interpret=interpret,
+                block_b=cand["block_b"],
+            )
+        )
+        jax.block_until_ready(fn(prev, cur, params))
+
+    return tune_spec, candidates, run
+
+
+def main(argv=None) -> int:
+    parser = _Parser(
+        prog="autotune", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument(
+        "--store", required=True, help="artifact store root"
+    )
+    parser.add_argument(
+        "--kernel",
+        choices=("sepconv", "cell", "all"),
+        default="all",
+        help="kernel family to tune (default: all)",
+    )
+    parser.add_argument(
+        "--preset",
+        choices=("tiny", "cifar"),
+        default="cifar",
+        help="workload shapes: 'cifar' = flagship NASNet-A signatures, "
+        "'tiny' = seconds-scale smoke shapes",
+    )
+    parser.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="report hit/pending per workload without sweeping or writing",
+    )
+    parser.add_argument(
+        "--interpret",
+        action="store_true",
+        help="force Pallas interpret mode (implied off-TPU)",
+    )
+    parser.add_argument(
+        "--repeats",
+        type=int,
+        default=2,
+        help="timed runs per candidate (best-of; default 2)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from adanet_tpu.ops import tuning
+    from adanet_tpu.store import ArtifactStore
+
+    try:
+        store = ArtifactStore(args.store)
+    except Exception as exc:
+        sys.stderr.write("autotune: unusable store: %s\n" % exc)
+        return 2
+
+    interpret = args.interpret or jax.default_backend() != "tpu"
+    kernels = (
+        ("sepconv", "cell") if args.kernel == "all" else (args.kernel,)
+    )
+    builders = {"sepconv": _tune_sepconv, "cell": _tune_cell}
+    workload_lists = {
+        "sepconv": _sepconv_workloads,
+        "cell": _cell_workloads,
+    }
+
+    report: Dict[str, Any] = {
+        "store": store.root,
+        "preset": args.preset,
+        "interpret": interpret,
+        "dry_run": args.dry_run,
+        "workloads": [],
+    }
+    searched = hits = pending = failed = 0
+    for kernel in kernels:
+        for workload in workload_lists[kernel](args.preset):
+            entry: Dict[str, Any] = {
+                "kernel": kernel,
+                "workload": {
+                    k: list(v) if isinstance(v, tuple) else v
+                    for k, v in workload.items()
+                },
+            }
+            try:
+                spec, candidates, run = builders[kernel](
+                    workload, interpret, args.repeats
+                )
+                name = tuning.tune_ref_name(kernel, spec)
+                entry["ref"] = name
+                existing = store.get_ref(tuning.TUNE_REF_KIND, name)
+                if existing is not None:
+                    hits += 1
+                    entry["status"] = "hit"
+                    entry["winner"] = (existing.get("meta") or {}).get(
+                        "winner"
+                    )
+                elif args.dry_run:
+                    pending += 1
+                    entry["status"] = "pending"
+                    entry["candidates"] = [
+                        c["block_b"] for c in candidates
+                    ]
+                else:
+                    winner, results = tuning.sweep(
+                        run, candidates, repeats=args.repeats
+                    )
+                    winner = dict(winner)
+                    winner["interpret"] = interpret
+                    tuning.record(store, kernel, spec, winner, results)
+                    searched += 1
+                    entry["status"] = "tuned"
+                    entry["winner"] = winner
+                    entry["candidates"] = results
+            except Exception as exc:
+                failed += 1
+                entry["status"] = "failed"
+                entry["error"] = "%s: %s" % (type(exc).__name__, exc)
+            report["workloads"].append(entry)
+
+    report["searched"] = searched
+    report["hits"] = hits
+    report["pending"] = pending
+    report["failed"] = failed
+    if failed:
+        code = 2
+    elif searched or pending:
+        code = 1
+    else:
+        code = 0
+    report["exit_code"] = code
+
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        for entry in report["workloads"]:
+            line = "%s %s: %s" % (
+                entry["kernel"],
+                entry.get("ref", "?"),
+                entry["status"],
+            )
+            winner = entry.get("winner")
+            if winner:
+                line += " (block_b=%s)" % winner.get("block_b")
+            if "error" in entry:
+                line += " [%s]" % entry["error"]
+            print(line)
+        print(
+            "searched=%d hits=%d pending=%d failed=%d"
+            % (searched, hits, pending, failed)
+        )
+    return code
+
+
+if __name__ == "__main__":
+    # Direct-script invocation (`python tools/autotune.py ...`) must
+    # find the repo package without an installed distribution; `-m`
+    # invocations already have the repo root on sys.path.
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.exit(main())

@@ -334,6 +334,9 @@ class Estimator:
         prefetch_to_device: bool = False,
         step_compute_dtype=None,
         export_serving: bool = False,
+        serving_cascade: bool = True,
+        cascade_target_agreement: float = 0.995,
+        cascade_calibration_batches: int = 8,
         artifact_store=None,
         store_spec_extra: Optional[Dict[str, Any]] = None,
     ):
@@ -410,6 +413,23 @@ class Estimator:
         # gate. Publication failures never stop the search — serving
         # simply stays on the previous generation.
         self._export_serving = bool(export_serving)
+        # Cascade auto-publication (ROADMAP item 4): every published
+        # generation also derives, exports, and calibrates a cascade
+        # spec from its own cheapest member — zero operator action, so
+        # every fleet flip ships a servable level 0. Calibration
+        # features come from a bounded reservoir of host feature
+        # batches collected during training (`_stash_calibration_batch`).
+        self._serving_cascade = bool(serving_cascade)
+        self._cascade_target_agreement = float(cascade_target_agreement)
+        if cascade_calibration_batches < 1:
+            raise ValueError(
+                "cascade_calibration_batches must be >= 1."
+            )
+        self._cascade_calibration_batches = int(
+            cascade_calibration_batches
+        )
+        self._cascade_calibration: list = []
+        self._calibration_pulls = 0
         if prefetch_buffer < 0:
             raise ValueError("prefetch_buffer must be >= 0.")
         self._prefetch_buffer = int(prefetch_buffer)
@@ -1309,7 +1329,46 @@ class Estimator:
                 data_iter = None
         if self._debug:
             self._check_batch_finite(batch)
+        self._stash_calibration_batch(batch)
         return batch, data_iter
+
+    #: Every Nth data pull feeds the cascade-calibration reservoir —
+    #: sparse enough that the host copy never shows on the step time.
+    _CALIBRATION_STRIDE = 16
+
+    def _stash_calibration_batch(self, batch) -> None:
+        """Feeds the publish-time cascade-calibration reservoir.
+
+        Keeps the last `cascade_calibration_batches` sampled FEATURE
+        batches as host copies (a prefetched device batch may be
+        donated into the train step; stashing the live reference would
+        read freed buffers at publish time). No-op unless serving
+        export + cascade auto-publication are both on.
+        """
+        if not (self._export_serving and self._serving_cascade):
+            return
+        self._calibration_pulls += 1
+        if (self._calibration_pulls - 1) % self._CALIBRATION_STRIDE:
+            return
+        try:
+            features = batch[0] if isinstance(batch, tuple) else batch
+            features = jax.tree_util.tree_map(
+                lambda leaf: np.asarray(jax.device_get(leaf)), features
+            )
+        except Exception:
+            _LOG.warning(
+                "Cascade calibration stash failed; publish-time "
+                "calibration falls back to the sample batch.",
+                exc_info=True,
+            )
+            return
+        self._cascade_calibration.append(features)
+        excess = (
+            len(self._cascade_calibration)
+            - self._cascade_calibration_batches
+        )
+        if excess > 0:
+            del self._cascade_calibration[:excess]
 
     @staticmethod
     def _check_batch_finite(batch):
@@ -2797,14 +2856,90 @@ class Estimator:
 
         return predict_fn
 
+    def _cheap_prefix_predict_fn(self, frozen, k: int = 1):
+        """`features -> predictions` of the ensemble's first (cheapest)
+        `k` members — a valid truncated ensemble because members are
+        frozen in cost order and the mixture weights align with them.
+        The generation's auto-published cascade level 0."""
+        ensembler = self._iteration_builder._ensembler_by_name(
+            frozen.ensembler_name
+        )
+        params = frozen.ensembler_params
+        if isinstance(params, dict) and isinstance(
+            params.get("weights"), (list, tuple)
+        ):
+            params = dict(params, weights=list(params["weights"])[:k])
+
+        def predict_fn(features):
+            features, _ = iteration_lib.split_example_weights(
+                features, self._weight_key, require=False
+            )
+            outs = frozen.member_outputs(features, training=False)[:k]
+            ensemble = ensembler.build_ensemble(params, outs)
+            return self._head.predictions(ensemble.logits)
+
+        return predict_fn
+
+    def _auto_cascade_spec(self, frozen, sample_features):
+        """The generation's auto-derived `CascadeSpec`, or None when a
+        cascade cannot help (single member, per-member export flags
+        making the trees incongruent, or a head without a categorical
+        logits leaf). Calibration runs on the training reservoir, the
+        sample batch standing in before the first stash."""
+        from adanet_tpu.serving.fleet import cascade as cascade_lib
+
+        if len(frozen.weighted_subnetworks) < 2:
+            return None  # level 0 WOULD BE the full ensemble
+        if (
+            self._export_subnetwork_logits
+            or self._export_subnetwork_last_layer
+        ):
+            # Per-member outputs give the full program extra leaves the
+            # level-0 prefix cannot emit; the flip gate's congruence
+            # check would reject the publication anyway.
+            return None
+        if self._head.logits_dimension < 2:
+            return None  # confidence = softmax max needs >= 2 classes
+        probe = self._head.predictions(
+            np.zeros((1, self._head.logits_dimension), np.float32)
+        )
+        logits_key = (
+            "logits"
+            if "logits" in probe
+            else cascade_lib.DEFAULT_LOGITS_KEY
+        )
+        if logits_key not in probe:
+            return None
+        batches = list(self._cascade_calibration) or [sample_features]
+
+        def cat(*leaves):
+            return np.concatenate(
+                [np.asarray(leaf) for leaf in leaves], axis=0
+            )
+
+        try:
+            calibration = jax.tree_util.tree_map(cat, *batches)
+        except Exception:
+            calibration = sample_features
+        return cascade_lib.CascadeSpec(
+            predict_fn=self._cheap_prefix_predict_fn(frozen),
+            calibration_features=calibration,
+            logits_key=logits_key,
+            target_agreement=self._cascade_target_agreement,
+            source="member",
+        )
+
     def _publish_serving_generation(self, t, frozen, sample_batch):
         """Chief-only, failure-isolated serving export of iteration t.
 
         Runs after the manifest write, so a published `gen-<t>` always
-        corresponds to a durably completed generation. Any failure is
-        logged and swallowed: the searcher must never die for the
-        serving plane, and the plane itself keeps answering from the
-        previous generation when a publish is missing.
+        corresponds to a durably completed generation. With
+        `serving_cascade` (default), the publication also derives and
+        calibrates a cascade spec from the generation's own cheapest
+        member — no operator-authored spec. Any failure is logged and
+        swallowed: the searcher must never die for the serving plane,
+        and the plane itself keeps answering from the previous
+        generation when a publish is missing.
         """
         from adanet_tpu.serving import publisher
 
@@ -2813,9 +2948,19 @@ class Estimator:
                 sample_batch, tuple
             ) else sample_batch
             features = jax.device_get(features)
+            cascade = None
+            if self._serving_cascade:
+                try:
+                    cascade = self._auto_cascade_spec(frozen, features)
+                except Exception:
+                    _LOG.exception(
+                        "Cascade spec derivation for generation %d "
+                        "failed; publishing without a cascade.",
+                        t,
+                    )
             publisher.publish_generation(
                 self._model_dir, t, self._frozen_predict_fn(frozen),
-                features, store=self._artifact_store,
+                features, store=self._artifact_store, cascade=cascade,
             )
         except Exception:
             _LOG.exception(

@@ -14,16 +14,26 @@ that structure into a latency weapon:
   `serving_signature.json` under `cascade`, next to the serialized
   cheap program (`cascade.stablehlo`) — the serving plane needs no
   labels, no recalibration, no model code.
-- **serve time** (`clears` via `serving.Batcher`): the cheap program
-  runs first; when every real row's calibrated confidence clears the
-  threshold the batch is answered at `cascade_level=0`. Otherwise the
-  FULL ensemble runs on the same padded batch — the fallthrough
-  answer is bit-identical to a cascade-free server by construction
-  (same program, same bucket shape, same bytes).
+- **serve time** (`clear_mask` via `serving.Batcher`): the cheap
+  program runs first and every real row's calibrated confidence is
+  scored against the threshold. Rows that clear are answered at
+  `cascade_level=0`; only the residual rows fall through to the full
+  ensemble, re-bucketed as a *smaller* padded batch over the same AOT
+  bucket set — so the fleet pays the full-ensemble price for the
+  ~per-row holdout fallthrough rate, not the far larger
+  any-row-in-the-batch rate. Per-example independence of inference
+  programs (the property padded bucket batching already relies on)
+  makes each fallthrough row bit-identical to a cascade-free server's
+  answer for that row: same program, same row bytes, row-independent
+  computation. `clears` (all real rows clear) remains for the legacy
+  per-batch mode (`BatcherConfig(split_rows=False)`) and callers that
+  need a batch-level verdict.
 
-The decision is per dispatched batch, not per row: splitting rows
-between programs would re-batch mid-flight and break the
-bit-identity contract that makes the cascade safe to enable.
+A published record may also carry `shadow_divergence_bound`: the
+serve-time ceiling on argmax disagreement between level-0 answers and
+the full ensemble, enforced by the batcher's sampled shadow canary
+(divergence past the bound rolls the replica back to ensemble-only
+serving). `calibrate` derives it from the holdout with headroom.
 
 Host-only module: logits arrive as host arrays (the batcher already
 fetched them); everything here is numpy.
@@ -62,6 +72,11 @@ class CascadeSpec:
     calibration_labels: Optional[np.ndarray] = None
     logits_key: str = DEFAULT_LOGITS_KEY
     target_agreement: float = 0.995
+    #: Provenance of the level-0 program, recorded in the signature's
+    #: cascade block: "member" (truncated-prefix cheap ensemble, the
+    #: Estimator's auto-published default) or "distilled" (a
+    #: born-again KD student, `research/distill_to_serve`).
+    source: str = "member"
 
 
 def softmax(logits: np.ndarray, temperature: float = 1.0) -> np.ndarray:
@@ -172,12 +187,32 @@ def pick_threshold(
     return best
 
 
+def shadow_divergence_bound(
+    holdout_agreement: float, target_agreement: float
+) -> float:
+    """Serve-time ceiling on level-0 argmax disagreement vs the ensemble.
+
+    Expected disagreement on admitted rows is `1 - holdout_agreement`
+    (<= `1 - target_agreement` by threshold construction); the bound
+    triples that for sampling noise and floors at twice the target
+    slack so a perfect holdout (agreement 1.0) never publishes a
+    zero-tolerance bound that trips on the first disagreeing row.
+    """
+    return float(
+        max(
+            3.0 * (1.0 - float(holdout_agreement)),
+            2.0 * (1.0 - float(target_agreement)),
+        )
+    )
+
+
 def calibrate(
     cheap_logits: np.ndarray,
     full_logits: np.ndarray,
     labels: Optional[np.ndarray] = None,
     target_agreement: float = 0.995,
     logits_key: str = DEFAULT_LOGITS_KEY,
+    source: str = "member",
 ) -> Dict[str, Any]:
     """The publish-time calibration record for the serving signature."""
     cheap_logits = np.asarray(cheap_logits, np.float64)
@@ -194,6 +229,10 @@ def calibrate(
         target_agreement=float(target_agreement),
         logits_key=logits_key,
         holdout_rows=int(len(conf)),
+        source=str(source),
+        shadow_divergence_bound=shadow_divergence_bound(
+            record["holdout_agreement"], target_agreement
+        ),
     )
     return record
 
@@ -205,20 +244,37 @@ def _logits_leaf(outputs: Any, logits_key: str) -> Optional[np.ndarray]:
     return np.asarray(outputs)
 
 
-def clears(
+def clear_mask(
     cascade: Dict[str, Any], cheap_outputs: Any, real_rows: int
-) -> bool:
-    """True when every REAL row of the cheap outputs clears the margin.
+) -> Optional[np.ndarray]:
+    """Per-REAL-row boolean mask: True where the calibrated confidence
+    clears the published threshold (the row is answerable at level 0).
 
-    Padding rows are excluded: their zero features produce arbitrary
-    confidences and must not force (or mask) a fallthrough.
+    The mask covers exactly the first `real_rows` rows. Padding rows
+    are excluded by construction: their zero features produce
+    arbitrary confidences and must never force (or mask) a
+    fallthrough — the contract `clears` documented per-batch now holds
+    per row. Returns None when the outputs carry no scoreable logits
+    leaf (the caller must fall through whole).
     """
     logits = _logits_leaf(
         cheap_outputs, cascade.get("logits_key", DEFAULT_LOGITS_KEY)
     )
     if logits is None or logits.ndim < 2:
-        return False
+        return None
     conf = confidence(
         logits[:real_rows], float(cascade.get("temperature", 1.0))
     )
-    return bool(np.all(conf >= float(cascade.get("threshold", np.inf))))
+    return conf >= float(cascade.get("threshold", np.inf))
+
+
+def clears(
+    cascade: Dict[str, Any], cheap_outputs: Any, real_rows: int
+) -> bool:
+    """True when every REAL row of the cheap outputs clears the margin.
+
+    The batch-level verdict over `clear_mask` — padding rows are
+    excluded there; see its docstring for the per-row contract.
+    """
+    mask = clear_mask(cascade, cheap_outputs, real_rows)
+    return mask is not None and bool(np.all(mask))

@@ -126,6 +126,10 @@ class ReplicaConfig:
     tick_interval_secs: float = 0.05
     bucket_sizes: tuple = (1, 2, 4, 8)
     cascade: bool = True
+    #: Per-row cascade splitting (clear rows answered at level 0, only
+    #: the residual re-bucketed to the ensemble); False = legacy
+    #: per-batch rule. Ignored when `cascade` is off.
+    cascade_split_rows: bool = True
     canary_samples: int = 8
 
     def resolved_socket(self) -> str:
@@ -176,6 +180,7 @@ class ServingReplica:
             BatcherConfig(
                 bucket_sizes=config.bucket_sizes,
                 cascade=config.cascade,
+                split_rows=config.cascade_split_rows,
             ),
         )
         self.frontend = ServingFrontend(
@@ -367,7 +372,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--no-cascade",
         action="store_true",
-        help="always run the full ensemble",
+        help="always run the full ensemble (alias of --cascade-mode off)",
+    )
+    parser.add_argument(
+        "--cascade-mode",
+        choices=("row", "batch", "off"),
+        default="row",
+        help="row = per-row split (default), batch = legacy "
+        "whole-batch fallthrough, off = full ensemble always",
     )
     parser.add_argument(
         "--heartbeat-interval", type=float, default=0.2
@@ -389,7 +401,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             bucket_sizes=tuple(
                 int(b) for b in args.buckets.split(",") if b
             ),
-            cascade=not args.no_cascade,
+            cascade=not args.no_cascade and args.cascade_mode != "off",
+            cascade_split_rows=args.cascade_mode == "row",
             heartbeat_interval_secs=args.heartbeat_interval,
             heartbeat_stale_secs=args.heartbeat_stale,
         )

@@ -444,6 +444,17 @@ class ServingFrontend:
             "draining": self._draining,
             "statuses": dict(self.counters),
         }
+        # Cascade snapshot (threshold, per-row fallthrough, shadow
+        # divergence, rollback state) rides the heartbeat so
+        # `servectl cascade` sees the whole fleet without touching a
+        # replica; duck-typed batcher stubs may predate it.
+        cascade_stats = getattr(self.batcher, "cascade_stats", None)
+        if cascade_stats is not None:
+            try:
+                out["cascade"] = cascade_stats()
+            except Exception:
+                _LOG.exception("Cascade stats snapshot failed.")
+                out["cascade"] = None
         # Deprecated aliases (one release): bare status counts and the
         # pool's stats with a `pool_` prefix, exactly as before.
         for status, count in self.counters.items():
@@ -546,6 +557,9 @@ class ServingFrontend:
                     cascade_level = getattr(
                         self.batcher, "last_cascade_level", None
                     )
+                    row_fallthrough = getattr(
+                        self.batcher, "last_row_fallthrough", None
+                    )
                     if cascade_level is not None:
                         span.set(cascade_level=cascade_level)
             except Exception as exc:
@@ -561,14 +575,27 @@ class ServingFrontend:
                 continue
             self.budget.observe(self._clock() - started)
             self._g_exec_ewma.set(self.budget.estimate)
+            # Per-REQUEST cascade level: with the batcher's per-row
+            # fallthrough mask, a request whose rows all cleared is
+            # level 0 even when a neighboring request in the same
+            # padded batch fell through (the batch-level field stays
+            # the dispatch summary on the span).
+            offset = 0
             for request, out in zip(ready, outputs):
+                level = cascade_level
+                if row_fallthrough is not None:
+                    rows = self._rows(request)
+                    level = int(
+                        bool(row_fallthrough[offset:offset + rows].any())
+                    )
+                    offset += rows
                 self._count(STATUS_OK)
                 request.respond(
                     ServeResult(
                         status=STATUS_OK,
                         outputs=out,
                         generation=record.iteration_number,
-                        cascade_level=cascade_level,
+                        cascade_level=level,
                     )
                 )
 

@@ -167,9 +167,23 @@ def gate_generation(
         if not outputs_finite(outputs):
             raise ValueError("non-finite outputs on the smoke sample")
         if cascade_program is not None:
-            if not outputs_finite(cascade_program(sample)):
+            cascade_outputs = cascade_program(sample)
+            if not outputs_finite(cascade_outputs):
                 raise ValueError(
                     "non-finite cascade outputs on the smoke sample"
+                )
+            # Per-row splitting scatters ensemble rows INTO the
+            # level-0 output tree; incongruent trees (a distilled
+            # student emitting a different head structure) must fail
+            # here, at flip time, not at serve time.
+            import jax
+
+            if jax.tree_util.tree_structure(
+                cascade_outputs
+            ) != jax.tree_util.tree_structure(outputs):
+                raise ValueError(
+                    "cascade output tree does not match the full "
+                    "program's (per-row fallthrough cannot scatter)"
                 )
     except Exception as exc:
         raise GateError(

@@ -228,6 +228,7 @@ def _export_cascade(
         labels=cascade.calibration_labels,
         target_agreement=cascade.target_agreement,
         logits_key=cascade.logits_key,
+        source=getattr(cascade, "source", "member"),
     )
     record["program"] = export_lib.CASCADE_FILE
     signature_path = os.path.join(staging, export_lib.SIGNATURE_FILE)

@@ -596,9 +596,37 @@ def _drive_fleet_clients(balancer, num_clients, requests_per_client):
     }
 
 
+def _fleet_cascade_snapshot(fleet_dir):
+    """Fleet-mean cascade gauges from the live heartbeats: the true
+    per-ROW fallthrough rate and the per-batch rate next to it (the
+    gap per-row splitting converts into throughput), plus shadow state."""
+    from tools import servectl
+
+    beats = servectl.read_fleet_heartbeats(fleet_dir)
+    rows, batches, shadows = [], [], []
+    rollbacks = 0
+    for payload in beats.values():
+        cascade = payload.get("cascade") or {}
+        if cascade.get("row_fallthrough_rate") is not None:
+            rows.append(float(cascade["row_fallthrough_rate"]))
+        if cascade.get("fallthrough_rate") is not None:
+            batches.append(float(cascade["fallthrough_rate"]))
+        if cascade.get("shadow_divergence") is not None:
+            shadows.append(float(cascade["shadow_divergence"]))
+        if cascade.get("rollback") is not None:
+            rollbacks += 1
+    mean = lambda xs: round(float(np.mean(xs)), 4) if xs else None
+    return {
+        "row_fallthrough_rate": mean(rows),
+        "batch_fallthrough_rate": mean(batches),
+        "shadow_divergence": mean(shadows),
+        "rollbacks": rollbacks,
+    }
+
+
 def _measure_serving_fleet():
-    """Saturation curves for 1 vs 3 replicas plus cascade on/off (the
-    ISSUE 15 fleet gate's numbers).
+    """Saturation curves for 1 vs 3 replicas plus the cascade arms
+    (ISSUE 15's fleet gate + ISSUE 18's per-row split).
 
     Each arm publishes ONE real cascade-calibrated generation, launches
     replica subprocesses through the same `tools/servectl.py` spawn
@@ -607,8 +635,12 @@ def _measure_serving_fleet():
     step's with no qps gain) or the ramp's end. `fleet_beats_single_qps`
     is the headline verdict: the 3-replica fleet's peak throughput must
     beat the single replica's. The cascade arms re-drive the 3-replica
-    fleet at a fixed mid-ramp load with the cascade disabled for the
-    latency/fallthrough delta.
+    fleet at a fixed mid-ramp load in three modes — per-row split
+    (clear rows at level 0, residual re-bucketed to the ensemble),
+    legacy per-batch fallthrough, and cascade off — reporting QPS,
+    p50/p99, and the per-row vs per-batch fallthrough gauges from the
+    replicas' heartbeats; `row_split_beats_batch` is the ISSUE 18
+    verdict (a QPS or p99 win at fixed load).
     """
     import shutil
     import tempfile
@@ -650,7 +682,7 @@ def _measure_serving_fleet():
         )
         return {"predictions": member1 + 0.5 * member2}
 
-    def run_fleet(tag, replicas, cascade, client_steps):
+    def run_fleet(tag, replicas, cascade_mode, client_steps):
         fleet_dir = os.path.join(root, tag)
         model_dir = os.path.join(fleet_dir, "model")
         os.makedirs(model_dir)
@@ -685,7 +717,8 @@ def _measure_serving_fleet():
                 model_dir,
                 rid,
                 env=env,
-                cascade=cascade,
+                cascade=cascade_mode != "off",
+                cascade_mode=cascade_mode,
                 heartbeat_interval=0.1,
                 # One core per replica (round-robin past the host's
                 # count): the fleet claim is "N replicas = N units of
@@ -731,7 +764,11 @@ def _measure_serving_fleet():
                 best_qps = max(best_qps, step["qps"])
                 if knee:
                     break
-            return steps
+            # Heartbeats are the source of truth for the per-ROW vs
+            # per-batch fallthrough gauges (the client only sees the
+            # per-request level); snapshot them while the fleet lives.
+            time.sleep(0.3)
+            return steps, _fleet_cascade_snapshot(fleet_dir)
         finally:
             if balancer is not None:
                 balancer.close()
@@ -745,23 +782,39 @@ def _measure_serving_fleet():
             shutil.rmtree(fleet_dir, ignore_errors=True)
 
     try:
-        single = run_fleet(
-            "single", 1, True, FLEET_SERVING_CLIENT_RAMP
+        single, _ = run_fleet(
+            "single", 1, "row", FLEET_SERVING_CLIENT_RAMP
         )
-        fleet = run_fleet("fleet3", 3, True, FLEET_SERVING_CLIENT_RAMP)
-        # Cascade delta at a fixed mid-ramp load on the 3-replica
-        # fleet: same model, cascade answered vs always-full.
+        fleet, _ = run_fleet(
+            "fleet3", 3, "row", FLEET_SERVING_CLIENT_RAMP
+        )
+        # Cascade arms at a fixed mid-ramp load on the 3-replica
+        # fleet: same model, same clients — per-row splitting vs the
+        # legacy per-batch fallthrough vs no cascade at all.
         mid = FLEET_SERVING_CLIENT_RAMP[
             len(FLEET_SERVING_CLIENT_RAMP) // 2
         ]
-        cascade_on = run_fleet("cascade-on", 3, True, (mid,))[-1]
-        cascade_off = run_fleet("cascade-off", 3, False, (mid,))[-1]
+        row_steps, row_hb = run_fleet("cascade-row", 3, "row", (mid,))
+        batch_steps, batch_hb = run_fleet(
+            "cascade-batch", 3, "batch", (mid,)
+        )
+        off_steps, _ = run_fleet("cascade-off", 3, "off", (mid,))
+        cascade_row = row_steps[-1]
+        cascade_batch = batch_steps[-1]
+        cascade_off = off_steps[-1]
         peak = lambda steps: max(
             (s["qps"] for s in steps if s["qps"]), default=0.0
         )
         errors = sum(
             s["error"]
-            for s in single + fleet + [cascade_on, cascade_off]
+            for s in single
+            + fleet
+            + [cascade_row, cascade_batch, cascade_off]
+        )
+        delta = lambda a, b, key: (
+            round(a[key] - b[key], 3)
+            if a[key] is not None and b[key] is not None
+            else None
         )
         return {
             "replicas_1": single,
@@ -772,17 +825,41 @@ def _measure_serving_fleet():
             "fleet_beats_single_qps": peak(fleet) > peak(single),
             "cascade": {
                 "clients": mid,
-                "on": cascade_on,
+                # `heartbeat` carries the batcher gauges: the true
+                # per-ROW fallthrough rate next to the per-batch rate —
+                # the gap is the traffic per-row splitting answers at
+                # level 0 that per-batch mode sends to the ensemble.
+                "row": dict(cascade_row, heartbeat=row_hb),
+                "batch": dict(cascade_batch, heartbeat=batch_hb),
                 "off": cascade_off,
-                "p50_delta_ms": (
-                    round(
-                        cascade_off["p50_ms"] - cascade_on["p50_ms"], 3
-                    )
-                    if cascade_on["p50_ms"] is not None
-                    and cascade_off["p50_ms"] is not None
-                    else None
+                "p50_delta_ms_row_vs_batch": delta(
+                    cascade_batch, cascade_row, "p50_ms"
                 ),
-                "fallthrough_rate": cascade_on["fallthrough_rate"],
+                "p99_delta_ms_row_vs_batch": delta(
+                    cascade_batch, cascade_row, "p99_ms"
+                ),
+                "qps_delta_row_vs_batch": delta(
+                    cascade_row, cascade_batch, "qps"
+                ),
+                "p50_delta_ms_off_vs_row": delta(
+                    cascade_off, cascade_row, "p50_ms"
+                ),
+                # The ISSUE 18 verdict: per-row splitting must convert
+                # its level-0 answers into a throughput or tail win at
+                # the same offered load.
+                "row_split_beats_batch": bool(
+                    (
+                        cascade_row["qps"] is not None
+                        and cascade_batch["qps"] is not None
+                        and cascade_row["qps"] > cascade_batch["qps"]
+                    )
+                    or (
+                        cascade_row["p99_ms"] is not None
+                        and cascade_batch["p99_ms"] is not None
+                        and cascade_row["p99_ms"]
+                        < cascade_batch["p99_ms"]
+                    )
+                ),
             },
             "error": errors,
             "requests_per_client": FLEET_SERVING_REQUESTS,

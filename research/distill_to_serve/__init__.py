@@ -1,0 +1,30 @@
+"""Born-again distillation into the serving cascade's level 0.
+
+`research/improve_nas` carries the born-again knowledge-distillation
+recipe (Furlanello et al.: a student trained against the teacher's
+soft labels, no ground truth needed). This package points that recipe
+at the serving plane: a small student distilled against a FROZEN
+AdaNet ensemble becomes the generation's `cascade.stablehlo` level-0
+program — a single cheap program answering the easy rows, with the
+full ensemble it was distilled from riding the batcher's shadow canary
+to catch drift (`serving.cascade.shadow_divergence` rollback).
+
+See README.md for the lifecycle and docs/serving.md's cascade section
+for the serve-time state machine.
+"""
+
+from research.distill_to_serve.distill import (
+    DistillConfig,
+    StudentMLP,
+    distill_and_publish,
+    distill_student,
+    teacher_from_generation,
+)
+
+__all__ = [
+    "DistillConfig",
+    "StudentMLP",
+    "distill_and_publish",
+    "distill_student",
+    "teacher_from_generation",
+]

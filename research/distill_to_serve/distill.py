@@ -1,0 +1,302 @@
+"""Distill a frozen ensemble into a single-program cascade level 0.
+
+The driver behind the "KD student as level 0" serving mode:
+
+1. **Teacher**: the frozen full ensemble — either a live predict fn
+   (the Estimator's `_frozen_predict_fn`) or a published generation's
+   hermetic StableHLO program (`teacher_from_generation`).
+2. **Student** (`distill_student`): a small MLP trained with the
+   born-again objective from `research/improve_nas` —
+   `_distillation_loss(student_logits, teacher_logits)`, cross-entropy
+   against the teacher's soft labels, no ground-truth labels anywhere.
+3. **Publication** (`distill_and_publish`): the student rides the
+   standard cascade publication (`serving/publisher.py`) as the
+   generation's `cascade.stablehlo`, calibrated on a held-out stream
+   with `source="distilled"` in the signature's cascade block. At
+   serve time the batcher answers clear rows from the student, falls
+   the residual through to the ensemble per row, and shadow-scores the
+   student against the ensemble — drift past the published
+   `shadow_divergence_bound` rolls the replica back to ensemble-only.
+
+The student's output tree is rebuilt to be congruent with the
+teacher's (the flip gate rejects incongruent cascades: per-row
+fallthrough must scatter ensemble rows INTO the level-0 tree), with
+probability/class leaves derived from the student's own logits.
+
+Demo driver (synthetic teacher, publishes generation 0):
+
+    python -m research.distill_to_serve.distill /tmp/distilled-model
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from research.improve_nas.trainer.improve_nas import _distillation_loss
+
+_LOG = logging.getLogger("adanet_tpu")
+
+
+@dataclasses.dataclass
+class DistillConfig:
+    """Student architecture + born-again training schedule."""
+
+    hidden: Tuple[int, ...] = (64, 64)
+    steps: int = 400
+    learning_rate: float = 1e-3
+    seed: int = 0
+    #: Key of the logits leaf in the teacher's output tree (matches
+    #: the cascade record's `logits_key`).
+    logits_key: str = "predictions"
+    target_agreement: float = 0.995
+
+
+class StudentMLP(nn.Module):
+    """The distilled level-0 program body: flatten every feature leaf,
+    concatenate, and run a small MLP to the teacher's logits width."""
+
+    hidden: Tuple[int, ...]
+    num_outputs: int
+
+    @nn.compact
+    def __call__(self, features):
+        leaves = jax.tree_util.tree_leaves(features)
+        x = jnp.concatenate(
+            [
+                jnp.reshape(
+                    jnp.asarray(leaf, jnp.float32), (leaf.shape[0], -1)
+                )
+                for leaf in leaves
+            ],
+            axis=-1,
+        )
+        for i, width in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(width, name="dense_%d" % i)(x))
+        return nn.Dense(self.num_outputs, name="logits")(x)
+
+
+def _logits_leaf(outputs: Any, logits_key: str) -> np.ndarray:
+    if isinstance(outputs, dict):
+        return np.asarray(jax.device_get(outputs[logits_key]))
+    return np.asarray(jax.device_get(outputs))
+
+
+def _student_outputs_like(template: Any, logits_key: str):
+    """`logits -> output tree` congruent with the teacher's.
+
+    Derived leaves come from the STUDENT's logits (softmax
+    probabilities, argmax class ids, sigmoid logistic) — never copied
+    from the teacher, which is absent at serve time. Unknown keys make
+    the distillation unusable as a cascade and raise here, at build
+    time, rather than failing the flip gate later.
+    """
+    if not isinstance(template, dict):
+        return lambda logits: logits
+
+    def build(logits) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key in template:
+            if key in (logits_key, "logits", "predictions"):
+                out[key] = logits
+            elif key == "probabilities":
+                out[key] = jax.nn.softmax(logits, axis=-1)
+            elif key == "class_ids":
+                out[key] = jnp.argmax(logits, axis=-1)
+            elif key == "logistic":
+                out[key] = jax.nn.sigmoid(logits)
+            else:
+                raise ValueError(
+                    "Cannot derive teacher output leaf %r from "
+                    "student logits; distillation cannot produce a "
+                    "congruent level-0 tree." % key
+                )
+        return out
+
+    return build
+
+
+def distill_student(
+    teacher_fn: Callable,
+    feature_batches: Sequence[Any],
+    config: Optional[DistillConfig] = None,
+) -> Tuple[Callable, Dict[str, Any]]:
+    """Trains a born-again student against the frozen teacher.
+
+    Teacher logits are computed OUTSIDE the jitted update (the teacher
+    may be a loaded StableHLO program — hermetic, not traceable), once
+    per batch, then cycled for `config.steps` steps. Returns
+    `(predict_fn, report)`: `predict_fn(features)` emits a tree
+    congruent with the teacher's, ready for `CascadeSpec.predict_fn`;
+    the report carries the final loss and the train-stream argmax
+    agreement with the teacher.
+    """
+    config = config or DistillConfig()
+    if not feature_batches:
+        raise ValueError("feature_batches must be non-empty.")
+    targets: List[np.ndarray] = []
+    template = None
+    for features in feature_batches:
+        outputs = teacher_fn(features)
+        if template is None:
+            template = outputs
+        targets.append(_logits_leaf(outputs, config.logits_key))
+    num_outputs = int(targets[0].shape[-1])
+    student = StudentMLP(tuple(config.hidden), num_outputs)
+    params = student.init(
+        jax.random.PRNGKey(config.seed), feature_batches[0]
+    )
+    tx = optax.adam(config.learning_rate)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def update(params, opt_state, features, teacher_logits):
+        def loss_fn(p):
+            return _distillation_loss(
+                student.apply(p, features), teacher_logits
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for step in range(config.steps):
+        batch = step % len(feature_batches)
+        params, opt_state, loss = update(
+            params, opt_state, feature_batches[batch], targets[batch]
+        )
+    agree = total = 0
+    for features, teacher_logits in zip(feature_batches, targets):
+        student_logits = np.asarray(
+            jax.device_get(student.apply(params, features))
+        )
+        agree += int(
+            np.sum(
+                student_logits.argmax(-1) == teacher_logits.argmax(-1)
+            )
+        )
+        total += len(teacher_logits)
+    build = _student_outputs_like(template, config.logits_key)
+
+    def predict_fn(features):
+        return build(student.apply(params, features))
+
+    report = {
+        "final_loss": float(loss),
+        "steps": int(config.steps),
+        "train_rows": int(total),
+        "teacher_agreement": float(agree) / float(max(total, 1)),
+    }
+    _LOG.info("Distilled student: %s", report)
+    return predict_fn, report
+
+
+def teacher_from_generation(gen_dir: str) -> Callable:
+    """The published full-ensemble program as a teacher callable.
+
+    Hermetic by construction (`core/export.py`): no model code, no
+    parameters — exactly the frozen artifact the student must shadow.
+    """
+    from adanet_tpu.core import export as export_lib
+
+    return export_lib.load_serving_program(gen_dir)
+
+
+def distill_and_publish(
+    model_dir: str,
+    iteration_number: int,
+    teacher_fn: Callable,
+    feature_batches: Sequence[Any],
+    config: Optional[DistillConfig] = None,
+    calibration_features: Any = None,
+    store=None,
+) -> Optional[str]:
+    """Distills a student and publishes teacher + student as one
+    generation: the ensemble as the serving program, the student as
+    its calibrated `cascade.stablehlo` level 0 (`source="distilled"`).
+
+    `calibration_features` defaults to the concatenated training
+    stream — pass a held-out stream for honest thresholds. Returns the
+    published directory (None when the generation already exists;
+    publication is set-once).
+    """
+    from adanet_tpu.serving import publisher
+    from adanet_tpu.serving.fleet import cascade as cascade_lib
+
+    config = config or DistillConfig()
+    predict_fn, _ = distill_student(teacher_fn, feature_batches, config)
+    if calibration_features is None:
+        calibration_features = jax.tree_util.tree_map(
+            lambda *leaves: np.concatenate(
+                [np.asarray(leaf) for leaf in leaves], axis=0
+            ),
+            *feature_batches,
+        )
+    spec = cascade_lib.CascadeSpec(
+        predict_fn=predict_fn,
+        calibration_features=calibration_features,
+        logits_key=config.logits_key,
+        target_agreement=config.target_agreement,
+        source="distilled",
+    )
+    return publisher.publish_generation(
+        model_dir,
+        iteration_number,
+        teacher_fn,
+        jax.tree_util.tree_map(
+            lambda leaf: np.asarray(leaf), feature_batches[0]
+        ),
+        store=store,
+        cascade=spec,
+    )
+
+
+def _demo(argv: Optional[List[str]] = None) -> int:
+    """Synthetic end-to-end run: teacher MLP -> student -> publication."""
+    import json
+    import os
+    import sys
+
+    out_dir = (argv or sys.argv[1:])[0]
+    rng = np.random.RandomState(0)
+    hidden = rng.randn(16, 64).astype(np.float32)
+    head = rng.randn(64, 4).astype(np.float32)
+
+    def teacher_fn(features):
+        return {
+            "predictions": jnp.tanh(features["x"] @ hidden) @ head
+        }
+
+    batches = [
+        {"x": rng.randn(64, 16).astype(np.float32)} for _ in range(8)
+    ]
+    published = distill_and_publish(
+        out_dir, 0, teacher_fn, batches, DistillConfig(steps=200)
+    )
+    if published is None:
+        print("generation 0 already published under %s" % out_dir)
+        return 1
+    from adanet_tpu.core import export as export_lib
+
+    signature = export_lib.serving_signature(published)
+    print(
+        json.dumps(signature["cascade"], indent=2, sort_keys=True)
+    )
+    print("published %s" % os.path.abspath(published))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_demo())

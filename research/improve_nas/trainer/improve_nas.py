@@ -116,10 +116,13 @@ def _smoothed_softmax_cross_entropy(logits, labels, label_smoothing):
 def _distillation_loss(student_logits, teacher_logits):
     """CE of the student against the teacher's soft labels
     (reference: improve_nas.py:166-180)."""
+    # jaxlint: disable=JL010(loss/reduction boundary: softmax + CE accumulate in f32 regardless of the module's compute dtype; only the scalar loss leaves this function)
     soft = jax.nn.softmax(jnp.asarray(teacher_logits, jnp.float32))
     return jnp.mean(
         optax.softmax_cross_entropy(
-            jnp.asarray(student_logits, jnp.float32), soft
+            # jaxlint: disable=JL010(same f32 loss boundary as above)
+            jnp.asarray(student_logits, jnp.float32),
+            soft,
         )
     )
 

@@ -1016,41 +1016,49 @@ def test_cascade_publication_signature_and_gate(cascade_model_dir):
 def test_cascade_fallthrough_bit_identical_to_full_oracle(
     cascade_model_dir,
 ):
-    """The acceptance property: fallthrough answers are bit-identical
-    to a cascade-free server, and cheap answers really come from the
-    cheap tier (cascade_level tags them)."""
+    """The acceptance property, per ROW: every row the per-row cascade
+    sends to the ensemble is bit-identical to a cascade-free server's
+    answer for that row, every clear row really comes from the
+    published level-0 program, and `last_row_fallthrough` tags which
+    is which."""
     pool = ModelPool(cascade_model_dir)
     pool.poll()
     rng = np.random.RandomState(7)
     on = Batcher(pool, BatcherConfig(bucket_sizes=(4, 8)))
     off = Batcher(pool, BatcherConfig(bucket_sizes=(4, 8), cascade=False))
     record = pool.active_record()
-    saw_cheap = saw_fall = False
+    saw_cheap = saw_fall = saw_mixed = False
     for _ in range(40):
         x = {"x": rng.randn(2, 16).astype(np.float32)}
         _, answered = on.execute([x])
         _, oracle = off.execute([x])
         assert off.last_cascade_level is None
-        if on.last_cascade_level == 1:
-            saw_fall = True
-            np.testing.assert_array_equal(
-                np.asarray(answered[0]["predictions"]),
-                np.asarray(oracle[0]["predictions"]),
-            )
-        else:
-            assert on.last_cascade_level == 0
-            saw_cheap = True
-            cheap_oracle = record.cascade_program(
-                np.asarray(x["x"], np.float32)
-                if not isinstance(x, dict)
-                else {"x": np.concatenate([x["x"], np.zeros((2, 16), np.float32)])}
-            )
-            np.testing.assert_array_equal(
-                np.asarray(answered[0]["predictions"]),
-                np.asarray(cheap_oracle["predictions"])[:2],
-            )
+        assert off.last_row_fallthrough is None
+        mask = on.last_row_fallthrough
+        assert mask is not None and mask.shape == (2,)
+        assert on.last_cascade_level == (1 if mask.any() else 0)
+        cheap_oracle = record.cascade_program(
+            {"x": np.concatenate([x["x"], np.zeros((2, 16), np.float32)])}
+        )
+        ans = np.asarray(answered[0]["predictions"])
+        for row in range(2):
+            if mask[row]:
+                saw_fall = True
+                np.testing.assert_array_equal(
+                    ans[row],
+                    np.asarray(oracle[0]["predictions"])[row],
+                )
+            else:
+                saw_cheap = True
+                np.testing.assert_array_equal(
+                    ans[row],
+                    np.asarray(cheap_oracle["predictions"])[row],
+                )
+        if mask.any() and not mask.all():
+            saw_mixed = True
     assert saw_fall, "threshold never fell through in 40 batches"
     assert saw_cheap, "threshold never cleared in 40 batches"
+    assert saw_mixed, "no batch ever split between the tiers"
 
 
 def test_cascade_level_reaches_serve_result(cascade_model_dir):
@@ -1070,12 +1078,340 @@ def test_cascade_level_reaches_serve_result(cascade_model_dir):
         frontend.drain(timeout=10.0)
 
 
+class _CascadeStubPool:
+    """Minimal pool contract: one duck-typed record, host-side stub
+    programs (served with `jit=False`)."""
+
+    def __init__(self, record):
+        self.record = record
+
+    def active_record(self):
+        return self.record
+
+    def canary_record(self):
+        return None
+
+    @property
+    def active(self):
+        return self.record
+
+    def poll(self):
+        return False
+
+
+def _counting(fn):
+    """Wraps a program to count calls + record dispatched batch rows."""
+
+    def wrapped(features):
+        wrapped.calls += 1
+        wrapped.batch_rows.append(
+            int(np.asarray(next(iter(features.values()))).shape[0])
+        )
+        return fn(features)
+
+    wrapped.calls = 0
+    wrapped.batch_rows = []
+    return wrapped
+
+
+def _stub_cascade_record(cheap_fn, full_fn, t=0, threshold=0.9, **extra):
+    cascade = {
+        "temperature": 1.0,
+        "threshold": threshold,
+        "logits_key": "y",
+    }
+    cascade.update(extra)
+    return GenerationRecord(
+        t,
+        "/nonexistent-gen-%d" % t,
+        full_fn,
+        {},
+        cascade_program=cheap_fn,
+        cascade=cascade,
+    )
+
+
+def _margin_programs():
+    """Cheap logits [x0, 0]: row clears iff x0 >= ln(9) (~2.2) at
+    threshold 0.9; padding rows (x0 == 0) sit at confidence 0.5. The
+    full program shifts by +100 so provenance is unambiguous."""
+
+    def cheap_fn(features):
+        x0 = np.asarray(features["x"])[:, 0]
+        return {"y": np.stack([x0, np.zeros_like(x0)], axis=-1)}
+
+    def full_fn(features):
+        x0 = np.asarray(features["x"])[:, 0]
+        return {"y": np.stack([x0 + 100.0, np.zeros_like(x0)], axis=-1)}
+
+    return _counting(cheap_fn), _counting(full_fn)
+
+
+def _row(x0):
+    return {"x": np.array([[x0, 0.0]], np.float32)}
+
+
+def test_cascade_residual_rebucketing_edges():
+    """The re-bucketing edge cases of per-row splitting: an all-clear
+    batch never touches the ensemble, a zero-clear batch runs it once
+    on the original bucket, and a small residual re-buckets to the
+    SMALLEST holding bucket with clear/fallthrough rows scattered
+    bit-exactly."""
+    cheap_fn, full_fn = _margin_programs()
+    batcher = Batcher(
+        _CascadeStubPool(_stub_cascade_record(cheap_fn, full_fn)),
+        BatcherConfig(bucket_sizes=(4, 8), jit=False, shadow_every=0),
+    )
+    # All rows clear: answered at level 0, the ensemble NEVER runs.
+    _, out = batcher.execute([_row(5.0), _row(6.0)])
+    assert batcher.last_cascade_level == 0
+    assert not batcher.last_row_fallthrough.any()
+    assert full_fn.calls == 0
+    np.testing.assert_array_equal(
+        np.asarray(out[0]["y"]), [[5.0, 0.0]]
+    )
+    # Zero rows clear: one full run on the ORIGINAL bucket (4), no
+    # residual dispatch.
+    _, out = batcher.execute([_row(0.5), _row(1.0)])
+    assert batcher.last_cascade_level == 1
+    assert batcher.last_row_fallthrough.all()
+    assert full_fn.calls == 1 and full_fn.batch_rows == [4]
+    np.testing.assert_array_equal(
+        np.asarray(out[1]["y"]), [[101.0, 0.0]]
+    )
+    # 6 real rows (bucket 8), ONE unclear: the residual re-buckets to
+    # the smallest bucket (4), and every row's provenance is exact.
+    full_fn.calls, full_fn.batch_rows = 0, []
+    xs = [5.0, 6.0, 0.5, 7.0, 8.0, 9.0]
+    _, out = batcher.execute([_row(x) for x in xs])
+    mask = batcher.last_row_fallthrough
+    np.testing.assert_array_equal(
+        mask, [False, False, True, False, False, False]
+    )
+    assert batcher.last_cascade_level == 1
+    assert full_fn.calls == 1 and full_fn.batch_rows == [4]
+    for i, x in enumerate(xs):
+        expected = x + 100.0 if mask[i] else x
+        np.testing.assert_array_equal(
+            np.asarray(out[i]["y"]), [[expected, 0.0]]
+        )
+
+
+def test_cascade_padding_rows_never_force_fallthrough():
+    """Padding rows sit below the margin (x0=0 -> confidence 0.5) but
+    only REAL rows are scored: an all-clear 2-row batch in a 4-bucket
+    stays at level 0."""
+    cheap_fn, full_fn = _margin_programs()
+    batcher = Batcher(
+        _CascadeStubPool(_stub_cascade_record(cheap_fn, full_fn)),
+        BatcherConfig(bucket_sizes=(4,), jit=False, shadow_every=0),
+    )
+    _, _ = batcher.execute([_row(5.0), _row(6.0)])
+    assert batcher.last_cascade_level == 0
+    assert full_fn.calls == 0
+
+
+def test_cascade_padding_rows_never_mask_fallthrough():
+    """The inverse: a cheap program whose logits are [4 - x0, 0] makes
+    PADDING (x0=0) maximally confident while a real x0=4 row is not —
+    confident padding must not hide the real row's fallthrough."""
+
+    def cheap_fn(features):
+        x0 = np.asarray(features["x"])[:, 0]
+        return {"y": np.stack([4.0 - x0, np.zeros_like(x0)], axis=-1)}
+
+    def full_fn(features):
+        x0 = np.asarray(features["x"])[:, 0]
+        return {"y": np.stack([x0 + 100.0, np.zeros_like(x0)], axis=-1)}
+
+    full_fn = _counting(full_fn)
+    batcher = Batcher(
+        _CascadeStubPool(_stub_cascade_record(cheap_fn, full_fn)),
+        BatcherConfig(bucket_sizes=(4,), jit=False, shadow_every=0),
+    )
+    _, out = batcher.execute([_row(0.0), _row(4.0)])
+    np.testing.assert_array_equal(
+        batcher.last_row_fallthrough, [False, True]
+    )
+    assert full_fn.calls == 1
+    np.testing.assert_array_equal(
+        np.asarray(out[1]["y"]), [[104.0, 0.0]]
+    )
+
+
+def test_cascade_shadow_divergence_rolls_back_to_ensemble(tmp_path):
+    """The auto-rollback acceptance: a divergent level-0 program trips
+    the shadow canary past the published bound — the tripping batch is
+    re-answered by the full ensemble (no condemned answer is served),
+    the batcher serves ensemble-only for that generation with the
+    reason on the flight recorder, and a new generation flip resets the
+    rollback."""
+    from adanet_tpu.observability import flightrec
+
+    # Divergent level 0: confidently argmax-0 where the ensemble says
+    # argmax-1, on every row.
+    def cheap_fn(features):
+        n = np.asarray(features["x"]).shape[0]
+        return {"y": np.tile([10.0, 0.0], (n, 1))}
+
+    def full_fn(features):
+        n = np.asarray(features["x"]).shape[0]
+        return {"y": np.tile([0.0, 10.0], (n, 1))}
+
+    pool = _CascadeStubPool(
+        _stub_cascade_record(
+            cheap_fn, full_fn, shadow_divergence_bound=0.05
+        )
+    )
+    batcher = Batcher(
+        pool,
+        BatcherConfig(
+            bucket_sizes=(4,),
+            jit=False,
+            shadow_every=1,
+            shadow_min_rows=2,
+        ),
+    )
+    recorder = flightrec.install(
+        flightrec.FlightRecorder(str(tmp_path / "flightrec"))
+    )
+    try:
+        before = batcher._m_cascade_rollbacks.value
+        _, out = batcher.execute(
+            [{"x": np.zeros((4, 2), np.float32)}]
+        )
+        # The shadow tripped ON this batch: every row re-answered by
+        # the ensemble, not the condemned level 0.
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["y"]), np.tile([0.0, 10.0], (4, 1))
+        )
+        assert batcher.last_row_fallthrough.all()
+        rollback = batcher.cascade_rollback
+        assert rollback is not None and rollback["generation"] == 0
+        assert "shadow divergence" in rollback["reason"]
+        assert rollback["shadow_divergence"] > rollback["bound"]
+        assert batcher._m_cascade_rollbacks.value == before + 1
+        # Forensics: the rollback dumped the flight recorder.
+        dump = json.load(open(recorder.dump_path))
+        assert any(
+            "cascade_shadow_rollback:gen-0" in r
+            for r in dump["reasons"]
+        )
+        # Ensemble-only from here for THIS generation; the stats
+        # surface carries the rollback fleet-wide.
+        _, out = batcher.execute(
+            [{"x": np.zeros((2, 2), np.float32)}]
+        )
+        assert batcher.last_cascade_level is None
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["y"]), np.tile([0.0, 10.0], (2, 1))
+        )
+        stats = batcher.cascade_stats()
+        assert stats["active"] is False
+        assert stats["rollback"]["generation"] == 0
+        # In-flight requests keep being answered through the frontend.
+        frontend = ServingFrontend(
+            batcher, FrontendConfig(default_deadline_secs=30.0)
+        ).start()
+        try:
+            result = frontend.submit(
+                {"x": np.zeros((2, 2), np.float32)}, timeout=60.0
+            )
+            assert result.ok
+        finally:
+            frontend.drain(timeout=10.0)
+        # A NEW generation (healthy level 0) resets the rollback.
+        pool.record = _stub_cascade_record(full_fn, full_fn, t=1)
+        _, _ = batcher.execute([{"x": np.zeros((2, 2), np.float32)}])
+        assert batcher.cascade_rollback is None
+        assert batcher.last_cascade_level in (0, 1)
+        assert batcher.cascade_stats()["active"] is True
+    finally:
+        flightrec.uninstall()
+
+
+def test_estimator_auto_publishes_calibrated_cascade(tmp_path):
+    """`export_serving=True` + the default `serving_cascade=True`: a
+    multi-class search publishes, with ZERO operator action, a
+    generation whose signature carries a calibrated cascade derived
+    from the ensemble's own cheapest member — and a pool + batcher
+    serve it with the cascade active."""
+    import optax
+
+    import adanet_tpu
+    from adanet_tpu.core import export as export_lib
+    from adanet_tpu.ensemble import ComplexityRegularizedEnsembler
+    from adanet_tpu.subnetwork import SimpleGenerator
+
+    from helpers import DNNBuilder
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(64, 2).astype(np.float32)
+    labels = (
+        (x[:, 0] > 0).astype(np.int32) + (x[:, 1] > 0).astype(np.int32)
+    )
+
+    def input_fn():
+        for start in range(0, 64, 16):
+            yield (
+                {"x": x[start : start + 16]},
+                labels[start : start + 16],
+            )
+
+    model_dir = str(tmp_path / "model")
+    est = adanet_tpu.Estimator(
+        head=adanet_tpu.MultiClassHead(3),
+        subnetwork_generator=SimpleGenerator(
+            [DNNBuilder("dnn", 1), DNNBuilder("deep", 2)]
+        ),
+        max_iteration_steps=8,
+        max_iterations=2,
+        ensemblers=[
+            ComplexityRegularizedEnsembler(optimizer=optax.sgd(0.05))
+        ],
+        model_dir=model_dir,
+        log_every_steps=0,
+        export_serving=True,
+        # A toy 8-step member won't reach the 0.995 default agreement
+        # (calibration would degrade to the safe full-fallthrough
+        # threshold 2.0); a modest target keeps the cascade live.
+        cascade_target_agreement=0.6,
+    )
+    est.train(input_fn, max_steps=100)
+    # Iteration 0's ensemble has ONE member: level 0 would BE the full
+    # program, so that generation publishes without a cascade.
+    gen0 = publisher.generation_dir(model_dir, 0)
+    assert "cascade" not in export_lib.serving_signature(gen0)
+    # Iteration 1 has two members: the auto-derived cascade ships,
+    # calibrated, sourced from the member prefix.
+    gen1 = publisher.generation_dir(model_dir, 1)
+    signature = export_lib.serving_signature(gen1)
+    cascade = signature["cascade"]
+    assert cascade["source"] == "member"
+    assert cascade["temperature"] > 0
+    assert 0.0 < cascade["threshold"] <= 1.0
+    assert cascade["holdout_agreement"] >= 0.6
+    assert "shadow_divergence_bound" in cascade
+    # The standard serve chain picks it up with the cascade active.
+    pool = ModelPool(model_dir)
+    assert pool.poll()
+    record = pool.active_record()
+    assert record.iteration_number == 1
+    assert record.cascade_program is not None
+    batcher = Batcher(pool, BatcherConfig(bucket_sizes=(4, 16)))
+    _, out = batcher.execute([{"x": x[:4]}])
+    assert batcher.cascade_stats()["active"] is True
+    assert batcher.last_row_fallthrough is not None
+    assert np.asarray(out[0]["probabilities"]).shape == (4, 3)
+
+
 # ----------------------------------------------------------- servectl CLI
 
 
 def test_servectl_launch_status_drain_exit_contract(tmp_path, capsys):
     """The operator loop end to end with the 0/1/2/64 contract shared
-    with ckpt_fsck/fleetctl."""
+    with ckpt_fsck/fleetctl — including the `cascade` subcommand over a
+    live cascade-published fleet."""
     import jax.numpy as jnp
 
     from tools import servectl
@@ -1085,18 +1421,25 @@ def test_servectl_launch_status_drain_exit_contract(tmp_path, capsys):
     os.makedirs(model_dir)
     rng = np.random.RandomState(0)
     w = rng.randn(16, 4).astype(np.float32)
+    w_cheap = w + 0.01 * rng.randn(16, 4).astype(np.float32)
     publisher.publish_generation(
         model_dir,
         0,
         lambda f: {"predictions": jnp.tanh(f["x"] @ w)},
         {"x": np.zeros((2, 16), np.float32)},
+        cascade=CascadeSpec(
+            lambda f: {"predictions": jnp.tanh(f["x"] @ w_cheap)},
+            {"x": rng.randn(256, 16).astype(np.float32)},
+            target_agreement=0.6,
+        ),
     )
     # Usage errors are EX_USAGE.
     with pytest.raises(SystemExit) as excinfo:
         servectl.main(["launch", fleet_dir])  # --model-dir missing
     assert excinfo.value.code == 64
-    # No fleet yet: status is unusable.
+    # No fleet yet: status and cascade census are unusable.
     assert servectl.main(["status", fleet_dir, "--json"]) == 2
+    assert servectl.main(["cascade", fleet_dir, "--json"]) == 2
     capsys.readouterr()
     try:
         assert (
@@ -1122,6 +1465,19 @@ def test_servectl_launch_status_drain_exit_contract(tmp_path, capsys):
             entry["state"] == "serving"
             for entry in status["replicas"].values()
         )
+        # The cascade census: both replicas serve the published
+        # cascade per-row, digest and calibration on display.
+        assert servectl.main(["cascade", fleet_dir, "--json"]) == 0
+        census = json.loads(capsys.readouterr().out)
+        assert sorted(census["replicas"]) == ["r0", "r1"]
+        for entry in census["replicas"].values():
+            assert entry["state"] == "cascade"
+            assert entry["mode"] == "row"
+            assert entry["generation"] == 0
+            assert entry["source"] == "member"
+            assert 0.0 < entry["threshold"] <= 1.0
+            assert entry["program_digest"]
+            assert entry["rollback"] is None
     finally:
         rc = servectl.main(["drain", fleet_dir, "--json"])
     assert rc == 0
@@ -1129,6 +1485,71 @@ def test_servectl_launch_status_drain_exit_contract(tmp_path, capsys):
     assert sorted(drained["drained"]) == ["r0", "r1"]
     # Everything exited: the census is now empty -> unusable.
     assert servectl.main(["status", fleet_dir, "--json"]) == 2
+    capsys.readouterr()
+    assert servectl.main(["cascade", fleet_dir, "--json"]) == 2
+
+
+def test_servectl_cascade_degraded_states(tmp_path, capsys):
+    """Exit 1 whenever any replica is NOT serving the published
+    cascade: a shadow rollback, an ensemble-only replica, or a missing
+    heartbeat — rendered per replica (synthesized heartbeats; the
+    happy path runs against live replicas above)."""
+    from adanet_tpu.serving import fleet as fleet_lib
+    from tools import servectl
+
+    fleet_dir = str(tmp_path / "fleet")
+    os.makedirs(fleet_dir)
+    with open(os.path.join(fleet_dir, servectl.FLEET_STATE), "w") as f:
+        json.dump(
+            {
+                "model_dir": str(tmp_path / "model"),
+                "replicas": [{"id": r} for r in ("r0", "r1", "r2")],
+            },
+            f,
+        )
+    kv = FileKV(os.path.join(fleet_dir, fleet_lib.replica.KV_SUBDIR))
+    base = {
+        "enabled": True,
+        "published": True,
+        "mode": "row",
+        "generation": 3,
+        "source": "distilled",
+        "threshold": 0.9,
+        "row_fallthrough_rate": 0.2,
+        "fallthrough_rate": 0.6,
+        "shadow_divergence": 0.01,
+        "shadow_divergence_bound": 0.05,
+        "rollback": None,
+    }
+    publish_heartbeat(
+        kv, NAMESPACE, "r0", {"ts": time.time(), "cascade": base}
+    )
+    publish_heartbeat(
+        kv,
+        NAMESPACE,
+        "r1",
+        {
+            "ts": time.time(),
+            "cascade": dict(
+                base,
+                rollback={
+                    "generation": 3,
+                    "reason": "shadow divergence 0.2 past bound 0.05",
+                },
+            ),
+        },
+    )
+    # r2 never heartbeats at all.
+    assert servectl.main(["cascade", fleet_dir, "--json"]) == 1
+    census = json.loads(capsys.readouterr().out)
+    assert census["replicas"]["r0"]["state"] == "cascade"
+    assert census["replicas"]["r1"]["state"] == "ensemble-only"
+    assert "shadow divergence" in census["replicas"]["r1"]["rollback"]["reason"]
+    assert census["replicas"]["r2"]["state"] == "missing"
+    # Human rendering carries the rollback reason too (exit code same).
+    assert servectl.main(["cascade", fleet_dir]) == 1
+    out = capsys.readouterr().out
+    assert "ROLLBACK" in out and "ensemble-only" in out
 
 
 # ------------------------------------------------- the chaos gate (tentpole)
@@ -1182,12 +1603,26 @@ def test_fleet_flip_sigkill_chaos_gate(tmp_path):
     rng = np.random.RandomState(0)
     w0 = rng.randn(16, 4).astype(np.float32)
     sample = {"x": np.zeros((2, 16), np.float32)}
+    holdout = {"x": rng.randn(256, 16).astype(np.float32)}
+
+    def _cascade_for(w):
+        # Near-identical cheap member: the fleet serves the per-row
+        # cascade (shadow canary armed, default row mode) THROUGH the
+        # chaos flip, not just plain programs.
+        w_cheap = w + 0.01 * rng.randn(16, 4).astype(np.float32)
+        return CascadeSpec(
+            lambda f: {"predictions": jnp.tanh(f["x"] @ w_cheap)},
+            holdout,
+            target_agreement=0.6,
+        )
+
     publisher.publish_generation(
         model_dir,
         0,
         lambda f: {"predictions": jnp.tanh(f["x"] @ w0)},
         sample,
         store=store,
+        cascade=_cascade_for(w0),
     )
 
     procs = {}
@@ -1260,6 +1695,7 @@ def test_fleet_flip_sigkill_chaos_gate(tmp_path):
             lambda f: {"predictions": jnp.tanh(f["x"] @ (w0 * 1.5))},
             sample,
             store=store,
+            cascade=_cascade_for(w0 * 1.5),
         )
         deadline = time.time() + 120
         while time.time() < deadline and procs[victim].poll() is None:
@@ -1313,6 +1749,15 @@ def test_fleet_flip_sigkill_chaos_gate(tmp_path):
             )
             with results_lock:
                 results.append(result)
+        # The per-row cascade survived the chaos flip on every live
+        # replica: published, shadow-canaried, and NOT rolled back.
+        beats = read_heartbeats(kv, NAMESPACE)
+        for rid in ("r0", "r1", victim):
+            cascade = beats[rid].get("cascade")
+            assert cascade, "replica %s lost cascade stats" % rid
+            assert cascade["published"] is True
+            assert cascade["mode"] == "row"
+            assert cascade["rollback"] is None
     finally:
         stop.set()
         for thread in threads:

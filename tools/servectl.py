@@ -5,16 +5,20 @@ fleet dir (`kv/` coordination store + `fleet.json` + per-replica unix
 sockets + optionally a shared artifact `store/`), serving one model
 dir's generation chain:
 
-    python -m tools.servectl launch FLEET_DIR --model-dir DIR --replicas 3
-    python -m tools.servectl status FLEET_DIR [--json]
-    python -m tools.servectl drain  FLEET_DIR [--json]
+    python -m tools.servectl launch  FLEET_DIR --model-dir DIR --replicas 3
+    python -m tools.servectl status  FLEET_DIR [--json]
+    python -m tools.servectl cascade FLEET_DIR [--json]
+    python -m tools.servectl drain   FLEET_DIR [--json]
 
 `launch` spawns replica processes
 (`python -m adanet_tpu.serving.fleet.replica`) detached with logs
 under `FLEET_DIR/logs/`, records them in `fleet.json`, and waits for
 their first heartbeats. `status` reads the heartbeat records the
-balancer routes on. `drain` SIGTERMs every recorded replica and waits
-for the frontends' drain contract (answer accepted work, then exit).
+balancer routes on. `cascade` renders each replica's cascade snapshot
+from the same heartbeats (level-0 program digest, threshold, live
+per-row fallthrough + shadow-divergence gauges, rollback state).
+`drain` SIGTERMs every recorded replica and waits for the frontends'
+drain contract (answer accepted work, then exit).
 
 Exit status (shared contract with `ckpt_fsck`/`fleetctl`):
     0  healthy: every expected replica fresh, one consistent
@@ -56,6 +60,7 @@ def replica_command(
     replica_id: str,
     buckets: str = "1,2,4,8",
     cascade: bool = True,
+    cascade_mode: Optional[str] = None,
     heartbeat_interval: float = 0.2,
     heartbeat_stale: float = 2.0,
     taskset_cpu: Optional[int] = None,
@@ -86,6 +91,8 @@ def replica_command(
     ]
     if not cascade:
         cmd.append("--no-cascade")
+    if cascade_mode is not None:
+        cmd += ["--cascade-mode", cascade_mode]
     return cmd
 
 
@@ -164,6 +171,7 @@ def _cmd_launch(args) -> int:
             rid,
             buckets=args.buckets,
             cascade=not args.no_cascade,
+            cascade_mode=args.cascade_mode,
         )
     state = {
         "model_dir": os.path.abspath(args.model_dir),
@@ -285,6 +293,130 @@ def _cmd_status(args) -> int:
     return rc
 
 
+def _cascade_report(fleet_dir: str, stale_secs: float = 3.0) -> dict:
+    """Fleet-wide cascade census from the heartbeat snapshots.
+
+    Exit semantics under the shared 0/1/2/64 contract:
+        0  cascade live everywhere: every fresh replica serves a
+           published cascade, no rollback
+        1  degraded: a rollback, a replica serving ensemble-only
+           (disabled / nothing published / stale), or a mixed fleet
+        2  no fleet state or no live replicas
+    """
+    state = _load_state(fleet_dir)
+    try:
+        beats = read_fleet_heartbeats(fleet_dir)
+    except Exception as exc:
+        return {
+            "fleet_dir": fleet_dir,
+            "error": "%s: %s" % (type(exc).__name__, exc),
+            "exit_code": 2,
+        }
+    now = time.time()
+    expected = [r["id"] for r in (state or {}).get("replicas", [])] or sorted(
+        beats
+    )
+    replicas = {}
+    live = 0
+    degraded = False
+    for rid in expected:
+        payload = beats.get(rid)
+        if payload is None:
+            replicas[rid] = {"state": "missing"}
+            degraded = True
+            continue
+        age = now - float(payload.get("ts", 0.0))
+        if age > stale_secs:
+            replicas[rid] = {
+                "state": "stale",
+                "heartbeat_age_secs": round(age, 3),
+            }
+            degraded = True
+            continue
+        live += 1
+        cascade = payload.get("cascade")
+        if not isinstance(cascade, dict):
+            replicas[rid] = {"state": "no-cascade-stats"}
+            degraded = True
+            continue
+        rollback = cascade.get("rollback")
+        serving_cascade = (
+            bool(cascade.get("enabled"))
+            and bool(cascade.get("published"))
+            and rollback is None
+        )
+        if not serving_cascade:
+            degraded = True
+        replicas[rid] = {
+            "state": "cascade" if serving_cascade else "ensemble-only",
+            "mode": cascade.get("mode"),
+            "generation": cascade.get("generation"),
+            "source": cascade.get("source"),
+            "program_digest": cascade.get("program_digest"),
+            "threshold": cascade.get("threshold"),
+            "row_fallthrough_rate": cascade.get("row_fallthrough_rate"),
+            "fallthrough_rate": cascade.get("fallthrough_rate"),
+            "shadow_divergence": cascade.get("shadow_divergence"),
+            "shadow_divergence_bound": cascade.get(
+                "shadow_divergence_bound"
+            ),
+            "rollback": rollback,
+        }
+    if not replicas or not live:
+        code = 2
+    elif degraded:
+        code = 1
+    else:
+        code = 0
+    return {
+        "fleet_dir": fleet_dir,
+        "model_dir": (state or {}).get("model_dir"),
+        "replicas": replicas,
+        "exit_code": code,
+    }
+
+
+def _cmd_cascade(args) -> int:
+    report = _cascade_report(args.fleet_dir, stale_secs=args.stale_secs)
+    rc = report["exit_code"]
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return rc
+    print(
+        "fleet %s  model=%s" % (args.fleet_dir, report.get("model_dir"))
+    )
+    for rid, entry in sorted(report.get("replicas", {}).items()):
+        if entry.get("state") in ("missing", "stale", "no-cascade-stats"):
+            print("  %-8s %s" % (rid, entry.get("state")))
+            continue
+        digest = entry.get("program_digest") or "-"
+        rollback = entry.get("rollback")
+        print(
+            "  %-8s %-13s mode=%-5s gen=%-4s src=%-9s thr=%-7s "
+            "row_fall=%-7s shadow=%-7s bound=%-7s level0=%.12s%s"
+            % (
+                rid,
+                entry.get("state"),
+                entry.get("mode"),
+                entry.get("generation"),
+                entry.get("source"),
+                _fmt(entry.get("threshold")),
+                _fmt(entry.get("row_fallthrough_rate")),
+                _fmt(entry.get("shadow_divergence")),
+                _fmt(entry.get("shadow_divergence_bound")),
+                digest,
+                "  ROLLBACK: %s" % rollback["reason"]
+                if isinstance(rollback, dict)
+                else "",
+            )
+        )
+    return rc
+
+
+def _fmt(value) -> str:
+    return "%.4f" % value if isinstance(value, float) else str(value)
+
+
 def _pid_running(pid: int) -> bool:
     """True while `pid` is alive and NOT a zombie.
 
@@ -358,6 +490,13 @@ def main(argv=None) -> int:
     launch.add_argument("--replicas", type=int, default=3)
     launch.add_argument("--buckets", default="1,2,4,8")
     launch.add_argument("--no-cascade", action="store_true")
+    launch.add_argument(
+        "--cascade-mode",
+        choices=("row", "batch", "off"),
+        default=None,
+        help="row = per-row split (replica default), batch = legacy "
+        "whole-batch fallthrough, off = ensemble only",
+    )
     launch.add_argument("--timeout", type=float, default=60.0)
     launch.add_argument("--json", action="store_true")
     status = sub.add_parser("status", help="heartbeat census")
@@ -370,6 +509,12 @@ def main(argv=None) -> int:
         help="heartbeat age past which a replica reads as stale "
         "(match the fleet's --heartbeat-interval when launched slow)",
     )
+    cascade = sub.add_parser(
+        "cascade", help="per-replica cascade census"
+    )
+    cascade.add_argument("fleet_dir")
+    cascade.add_argument("--json", action="store_true")
+    cascade.add_argument("--stale-secs", type=float, default=3.0)
     drain = sub.add_parser("drain", help="SIGTERM + wait for the fleet")
     drain.add_argument("fleet_dir")
     drain.add_argument("--timeout", type=float, default=60.0)
@@ -379,6 +524,8 @@ def main(argv=None) -> int:
         return _cmd_launch(args)
     if args.command == "status":
         return _cmd_status(args)
+    if args.command == "cascade":
+        return _cmd_cascade(args)
     return _cmd_drain(args)
 
 
